@@ -1,0 +1,28 @@
+"""fast_tffm_tpu — a TPU-native factorization-machine framework.
+
+A brand-new framework with the capabilities of ``douban/fast_tffm``
+(reference layout: ``run_tffm.py``, ``py/fm_train.py``, ``py/fm_predict.py``,
+``cc/fm_parser.cc``, ``cc/fm_scorer.cc``, ``cc/fm_grad.cc`` — see
+``SURVEY.md`` §1–§3; the reference snapshot was unreadable this session, so
+citations are upstream-path + SURVEY-section rather than file:line).
+
+Where the reference pairs C++ TensorFlow custom ops with TF1's asynchronous
+parameter-server runtime, this package is idiomatic JAX/XLA:
+
+- ``data/``      host-side libsvm parsing (C++ + Python), hashing, bucketed
+                 fixed-shape batching (the ``fm_parser`` equivalent).
+- ``ops/``       the FM interaction math as XLA and Pallas kernels with a
+                 custom VJP (the ``fm_scorer``/``fm_grad`` equivalents).
+- ``models/``    model definitions (2nd-order FM, higher-order FM, FFM) and
+                 a NumPy oracle used as ground truth in tests.
+- ``parallel/``  device meshes, row-sharded embedding tables, synchronous
+                 data-parallel training via ``shard_map`` + XLA collectives
+                 (the PS/worker-runtime equivalent).
+- ``utils/``     logging, timing, profiling helpers.
+- ``train.py`` / ``predict.py`` — drivers (the ``fm_train.py`` /
+                 ``fm_predict.py`` equivalents).
+"""
+
+__version__ = "0.1.0"
+
+from fast_tffm_tpu.config import FmConfig, load_config  # noqa: F401

@@ -1,0 +1,67 @@
+"""Checkpoint / resume via orbax — the ``tf.train.Saver`` equivalent.
+
+Reference behavior (SURVEY.md §5 "Checkpoint / resume"): periodic save
+through the managed session, restore-on-restart, final model at the
+config's ``model_file`` path; predict restores the same. Same contract
+here, with orbax's sharding-aware async-capable machinery underneath plus
+a dense ``.npz`` exporter for parity checks outside JAX.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+class CheckpointState:
+    """Manages checkpoints under ``<model_file>.ckpt/`` (orbax needs a
+    directory; the reference's ``model_file`` is a path prefix)."""
+
+    def __init__(self, model_file: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(model_file) + ".ckpt"
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
+                                                 create=True))
+
+    def save(self, step: int, table: jax.Array, acc: jax.Array,
+             force: bool = False) -> None:
+        self._mngr.save(step,
+                        args=ocp.args.StandardSave(
+                            {"table": table, "acc": acc,
+                             "step": np.int64(step)}),
+                        force=force)
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, step: Optional[int] = None,
+                template: Optional[Dict[str, Any]] = None
+                ) -> Optional[Dict[str, Any]]:
+        """Returns {"table", "acc", "step"} as host arrays, or None if no
+        checkpoint exists yet (fresh start). ``template`` is an abstract
+        pytree (jax.ShapeDtypeStruct leaves) matching what was saved;
+        required by orbax to reconstruct arrays."""
+        s = step if step is not None else self.latest_step()
+        if s is None:
+            return None
+        if template is None:
+            return self._mngr.restore(s)
+        return self._mngr.restore(s, args=ocp.args.StandardRestore(template))
+
+    def close(self) -> None:
+        self._mngr.close()
+
+
+def export_npz(table, path: str) -> None:
+    """Dense export of the parameter table (without the dead padding row)
+    for parity checks / external consumers."""
+    arr = np.asarray(table)[:-1]
+    os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
+    np.savez_compressed(path, table=arr)

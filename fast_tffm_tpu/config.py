@@ -1,0 +1,192 @@
+"""INI config surface compatible with the reference's ``sample.cfg``.
+
+The reference reads a single INI file with ``[General]``/``[Train]``/
+``[Predict]`` (and optionally ``[Cluster]``) sections via stdlib
+ConfigParser (SURVEY.md §2 "Config system", Appendix A). This module
+accepts that schema verbatim and parses it into one frozen dataclass; keys
+the reference does not have (``model_type``, ``order``, ``field_num``,
+bucketing knobs) extend the schema without breaking existing configs.
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+from typing import Optional, Tuple
+
+
+def _split_files(raw: str) -> Tuple[str, ...]:
+    """Comma/whitespace-separated file list (globs allowed) -> tuple."""
+    out = []
+    for part in raw.replace(",", " ").split():
+        if part:
+            out.append(part)
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class FmConfig:
+    # --- [General] ---------------------------------------------------------
+    vocabulary_size: int = 1 << 20
+    # Reference: table is split into `vocabulary_block_num` blocks round-
+    # robined across parameter servers (SURVEY §2 "Model parameters"). Here
+    # the analogue is the number of row shards of the mesh table; kept for
+    # config compatibility, the mesh decides actual sharding.
+    vocabulary_block_num: int = 1
+    hash_feature_id: bool = False
+    factor_num: int = 8
+    model_file: str = "./model/fm_model"
+    log_file: str = ""
+    # Extensions beyond upstream (BASELINE.json configs #3/#4):
+    model_type: str = "fm"          # "fm" | "ffm"
+    order: int = 2                  # >= 2; order>2 uses the ANOVA kernel
+    field_num: int = 0              # > 0 required for model_type == "ffm"
+
+    # --- [Train] -----------------------------------------------------------
+    train_files: Tuple[str, ...] = ()
+    weight_files: Tuple[str, ...] = ()
+    validation_files: Tuple[str, ...] = ()
+    epoch_num: int = 1
+    batch_size: int = 1024
+    learning_rate: float = 0.01
+    factor_lambda: float = 0.0
+    bias_lambda: float = 0.0
+    init_value_range: float = 0.01
+    loss_type: str = "logistic"     # "logistic" | "mse"
+    queue_size: int = 10000
+    shuffle_threads: int = 1
+    shuffle: bool = True
+    seed: int = 0
+    adagrad_init: float = 0.1       # TF Adagrad accumulator init default
+    save_steps: int = 0             # 0 = save only at end
+    log_steps: int = 100
+    # Static-shape bucketing (TPU-specific; SURVEY §7 hard part #1):
+    max_features_per_example: int = 256   # hard cap on nnz/example (truncate)
+    bucket_ladder: Tuple[int, ...] = (8, 16, 32, 64, 128, 256)
+    kernel: str = "xla"             # "xla" | "pallas"
+
+    # --- [Predict] ---------------------------------------------------------
+    predict_files: Tuple[str, ...] = ()
+    score_path: str = "./score"
+
+    # --- [Cluster] ---------------------------------------------------------
+    # Reference: ps_hosts/worker_hosts for the TF1 PS runtime (SURVEY §3.2).
+    # Here retained for CLI compatibility; mapped onto jax.distributed
+    # coordinator/process env (parallel/distributed.py).
+    ps_hosts: Tuple[str, ...] = ()
+    worker_hosts: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.order < 2:
+            raise ValueError(f"order must be >= 2, got {self.order}")
+        if self.model_type not in ("fm", "ffm"):
+            raise ValueError(f"unknown model_type {self.model_type!r}")
+        if self.model_type == "ffm":
+            if self.field_num <= 0:
+                raise ValueError("model_type=ffm requires field_num > 0")
+            if self.order != 2:
+                raise ValueError("ffm supports order=2 only")
+        if self.loss_type not in ("logistic", "mse"):
+            raise ValueError(f"unknown loss_type {self.loss_type!r}")
+        if self.kernel not in ("xla", "pallas"):
+            raise ValueError(f"unknown kernel {self.kernel!r}")
+        if self.factor_num <= 0:
+            raise ValueError("factor_num must be positive")
+        if self.vocabulary_size <= 0:
+            raise ValueError("vocabulary_size must be positive")
+
+    @property
+    def row_dim(self) -> int:
+        """Per-row parameter count: k latent factors (× fields for FFM) + 1
+        linear weight. Mirrors the reference's `[vocab, factor_num + 1]`
+        table layout (SURVEY §2 "Model parameters")."""
+        k = self.factor_num
+        if self.model_type == "ffm":
+            return k * self.field_num + 1
+        return k + 1
+
+    @property
+    def pad_id(self) -> int:
+        """Sentinel row index used for padding; one extra dead row is
+        appended to the table so padded positions gather zeros and their
+        gradients land harmlessly (and are masked out of the reg term)."""
+        return self.vocabulary_size
+
+    @property
+    def num_rows(self) -> int:
+        return self.vocabulary_size + 1
+
+
+_GENERAL_KEYS = {
+    "vocabulary_size": int,
+    "vocabulary_block_num": int,
+    "hash_feature_id": bool,
+    "factor_num": int,
+    "model_file": str,
+    "log_file": str,
+    "model_type": str,
+    "order": int,
+    "field_num": int,
+}
+_TRAIN_KEYS = {
+    "train_files": _split_files,
+    "weight_files": _split_files,
+    "validation_files": _split_files,
+    "epoch_num": int,
+    "batch_size": int,
+    "learning_rate": float,
+    "factor_lambda": float,
+    "bias_lambda": float,
+    "init_value_range": float,
+    "loss_type": str,
+    "queue_size": int,
+    "shuffle_threads": int,
+    "shuffle": bool,
+    "seed": int,
+    "adagrad_init": float,
+    "save_steps": int,
+    "log_steps": int,
+    "max_features_per_example": int,
+    "kernel": str,
+}
+_PREDICT_KEYS = {
+    "predict_files": _split_files,
+    "score_path": str,
+}
+_CLUSTER_KEYS = {
+    "ps_hosts": _split_files,
+    "worker_hosts": _split_files,
+}
+
+
+def load_config(path: str) -> FmConfig:
+    """Read a reference-style INI file into an FmConfig.
+
+    Unknown keys raise, so typos in configs fail loudly (the reference's
+    ConfigParser silently ignores them; failing loudly is strictly safer
+    and costs no compatibility for valid configs).
+    """
+    cp = configparser.ConfigParser(inline_comment_prefixes=(";", "#"))
+    read = cp.read(path)
+    if not read:
+        raise FileNotFoundError(path)
+
+    kwargs = {}
+
+    def consume(section: str, keys):
+        if not cp.has_section(section):
+            return
+        for name, raw in cp.items(section):
+            if name not in keys:
+                raise KeyError(f"unknown config key [{section}] {name}")
+            conv = keys[name]
+            if conv is bool:
+                kwargs[name] = cp.getboolean(section, name)
+            else:
+                kwargs[name] = conv(raw)
+
+    consume("General", _GENERAL_KEYS)
+    consume("Train", _TRAIN_KEYS)
+    consume("Predict", _PREDICT_KEYS)
+    consume("Cluster", _CLUSTER_KEYS)
+    return FmConfig(**kwargs)

@@ -1,0 +1,2 @@
+from fast_tffm_tpu.data.hashing import murmur64, hash_feature  # noqa: F401
+from fast_tffm_tpu.data.parser import ParsedBlock, parse_lines  # noqa: F401

@@ -1,0 +1,115 @@
+"""ctypes loader for the C++ parser extension (``_parser.cc``).
+
+The reference keeps line parsing in a C++ TF op because at target
+throughput (SURVEY.md §7 hard part #4: ~280k lines/s/host-group) Python
+string handling is the bottleneck. Here the same role is played by a plain
+shared object built from ``_parser.cc`` with g++ on first use (no TF/pybind
+dependency; see SURVEY §7 layer 2). ``parse_lines_fast`` matches
+``parser.parse_lines``'s contract bit-for-bit (golden tests enforce it).
+
+If the extension cannot be built/loaded, callers fall back to the Python
+parser (pipeline._parse_block).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from fast_tffm_tpu.data.parser import ParsedBlock, ParseError
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "_parser.cc")
+_SO = os.path.join(_HERE, "_parser.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+           "-pthread", "-o", _SO, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib, _load_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_error is not None:
+            raise RuntimeError(_load_error)
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.exists(_SRC)
+                    and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+                if not os.path.exists(_SRC):
+                    raise FileNotFoundError(_SRC)
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, FileNotFoundError, subprocess.CalledProcessError) as e:
+            _load_error = f"C++ parser unavailable: {e}"
+            raise RuntimeError(_load_error)
+        lib.fm_parse_block.restype = ctypes.c_int
+        lib.fm_parse_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,              # buffer, length
+            ctypes.c_int64, ctypes.c_int,                 # vocab, hash flag
+            ctypes.c_int,                                 # max feats/example
+            ctypes.c_int,                                 # num threads
+            ctypes.POINTER(ctypes.c_int64),               # out: n_examples
+            ctypes.POINTER(ctypes.c_int64),               # out: nnz
+            np.ctypeslib.ndpointer(np.float32),           # labels buf
+            np.ctypeslib.ndpointer(np.int32),             # poses buf
+            np.ctypeslib.ndpointer(np.int32),             # ids buf
+            np.ctypeslib.ndpointer(np.float32),           # vals buf
+            ctypes.c_char_p, ctypes.c_int64,              # err buf, err cap
+        ]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except RuntimeError:
+        return False
+
+
+def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
+                     hash_feature_id: bool = False,
+                     max_features_per_example: int = 0,
+                     num_threads: int = 0) -> ParsedBlock:
+    """C++-accelerated ``parse_lines`` (FM format only; FFM uses the
+    Python parser). Raises RuntimeError when the extension is unusable,
+    ParseError on malformed input."""
+    lib = _load()
+    blob = "\n".join(lines).encode("utf-8")
+    n_lines = len(lines)
+    # Worst-case token count bounds the output buffers: a feature token is
+    # at least 2 bytes ("i "), a line at least 2 ("0\n").
+    max_nnz = max(len(blob) // 2 + 1, 1)
+    labels = np.empty(n_lines, dtype=np.float32)
+    poses = np.empty(n_lines + 1, dtype=np.int32)
+    ids = np.empty(max_nnz, dtype=np.int32)
+    vals = np.empty(max_nnz, dtype=np.float32)
+    n_ex = ctypes.c_int64(0)
+    nnz = ctypes.c_int64(0)
+    errbuf = ctypes.create_string_buffer(512)
+    rc = lib.fm_parse_block(
+        blob, len(blob), vocabulary_size, int(hash_feature_id),
+        max_features_per_example, num_threads,
+        ctypes.byref(n_ex), ctypes.byref(nnz),
+        labels, poses, ids, vals, errbuf, len(errbuf))
+    if rc != 0:
+        raise ParseError(errbuf.value.decode("utf-8", "replace"))
+    b = n_ex.value
+    z = nnz.value
+    return ParsedBlock(labels=labels[:b].copy(), poses=poses[:b + 1].copy(),
+                       ids=ids[:z].copy(), vals=vals[:z].copy(), fields=None)

@@ -1,0 +1,146 @@
+"""libsvm-style line parsing — the ``fm_parser`` contract, host side.
+
+The reference's C++ ``fm_parser`` TF op turns a batch of text lines into a
+CSR batch: ``labels[B], sizes[B], feature_ids[nnz], feature_vals[nnz]``
+(SURVEY.md §2 and Appendix B). This module provides the same contract as a
+plain function over Python strings. A C++ implementation with the identical
+contract lives in ``_parser.cc`` (loaded via ctypes in ``cparser.py``);
+golden tests assert bit-identical outputs between the two.
+
+Line formats (SURVEY Appendix A data format):
+    FM :  <label> <fid>[:<fval>] ...
+    FFM:  <label> <field>:<fid>[:<fval>] ...
+``fval`` defaults to 1.0. ``fid`` is an integer < vocabulary_size unless
+``hash_feature_id``, in which case any string, MurmurHash64A'd mod
+``vocabulary_size`` (hashing.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from fast_tffm_tpu.data.hashing import hash_feature
+
+
+@dataclasses.dataclass
+class ParsedBlock:
+    """CSR batch: example e owns slice [poses[e], poses[e+1]) of the flat
+    arrays. Mirrors the reference op's outputs plus the cumsum the train
+    graph derives (SURVEY §3.1 ``poses = cumsum(sizes)``)."""
+    labels: np.ndarray        # f32 [B]
+    poses: np.ndarray         # i32 [B+1] row pointers
+    ids: np.ndarray           # i32 [nnz] row indices in [0, vocab)
+    vals: np.ndarray          # f32 [nnz]
+    fields: Optional[np.ndarray] = None   # i32 [nnz], FFM only
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.labels)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return np.diff(self.poses)
+
+
+class ParseError(ValueError):
+    pass
+
+
+def parse_lines(lines: Sequence[str], vocabulary_size: int,
+                hash_feature_id: bool = False,
+                field_aware: bool = False,
+                field_num: int = 0,
+                max_features_per_example: int = 0,
+                keep_empty: bool = False) -> ParsedBlock:
+    """Parse a block of lines into a CSR batch.
+
+    ``max_features_per_example`` > 0 truncates overlong examples (static-
+    shape discipline; SURVEY §7 hard part #1). Blank lines are skipped,
+    unless ``keep_empty`` — then they become zero-feature examples with
+    label 0, preserving line alignment (predict owes one score per input
+    line, SURVEY §3.4).
+    """
+    labels: List[float] = []
+    poses: List[int] = [0]
+    ids: List[int] = []
+    vals: List[float] = []
+    flds: List[int] = []
+
+    for lineno, line in enumerate(lines):
+        toks = line.split()
+        if not toks:
+            if keep_empty:
+                labels.append(0.0)
+                poses.append(len(ids))
+            continue
+        try:
+            label = float(toks[0])
+        except ValueError:
+            raise ParseError(f"line {lineno}: bad label {toks[0]!r}")
+        labels.append(label)
+        n = 0
+        for tok in toks[1:]:
+            if max_features_per_example and n >= max_features_per_example:
+                break
+            parts = tok.split(":")
+            if field_aware:
+                if len(parts) == 2:
+                    fld_s, fid_s, val_s = parts[0], parts[1], None
+                elif len(parts) == 3:
+                    fld_s, fid_s, val_s = parts
+                else:
+                    raise ParseError(
+                        f"line {lineno}: bad ffm token {tok!r} "
+                        "(want field:fid[:val])")
+                try:
+                    fld = int(fld_s)
+                except ValueError:
+                    raise ParseError(f"line {lineno}: bad field {fld_s!r}")
+                if not 0 <= fld < field_num:
+                    raise ParseError(
+                        f"line {lineno}: field {fld} out of range "
+                        f"[0, {field_num})")
+                flds.append(fld)
+            else:
+                if len(parts) == 1:
+                    fid_s, val_s = parts[0], None
+                elif len(parts) == 2:
+                    fid_s, val_s = parts
+                else:
+                    raise ParseError(
+                        f"line {lineno}: bad token {tok!r} (want fid[:val])")
+            if hash_feature_id:
+                fid = hash_feature(fid_s, vocabulary_size)
+            else:
+                try:
+                    fid = int(fid_s)
+                except ValueError:
+                    raise ParseError(
+                        f"line {lineno}: non-integer feature id {fid_s!r} "
+                        "(set hash_feature_id = True for string ids)")
+                if not 0 <= fid < vocabulary_size:
+                    raise ParseError(
+                        f"line {lineno}: feature id {fid} out of range "
+                        f"[0, {vocabulary_size})")
+            if val_s is None:
+                val = 1.0
+            else:
+                try:
+                    val = float(val_s)
+                except ValueError:
+                    raise ParseError(f"line {lineno}: bad value {val_s!r}")
+            ids.append(fid)
+            vals.append(val)
+            n += 1
+        poses.append(len(ids))
+
+    return ParsedBlock(
+        labels=np.asarray(labels, dtype=np.float32),
+        poses=np.asarray(poses, dtype=np.int32),
+        ids=np.asarray(ids, dtype=np.int32),
+        vals=np.asarray(vals, dtype=np.float32),
+        fields=np.asarray(flds, dtype=np.int32) if field_aware else None,
+    )

@@ -1,0 +1,238 @@
+"""Host input pipeline: text files -> fixed-shape device batches.
+
+Replaces the reference's TF queue-runner pipeline (filename queue ->
+TextLineReader.read_up_to -> shuffle queue; SURVEY.md §2 "Input pipeline",
+§3.1) with an epoch-aware Python iterator that emits **static-shape**
+batches XLA can compile once per bucket:
+
+- per-example feature counts are padded to a bucket ladder (``L``),
+- the batch's **unique** feature ids are computed on the host (the
+  reference does ``tf.unique`` in-graph; SURVEY §3.1) and padded to their
+  own ladder (``U``), so the device gathers ``U`` table rows instead of
+  ``B*L`` and gradient scatter-adds are already deduplicated,
+- short final batches are padded with zero-weight dummy examples.
+
+Padding invariants (relied on by ops/ and tests):
+- ``uniq_ids`` padding slots hold ``pad_id == vocabulary_size`` (a dead
+  extra table row); the last slot is always padding.
+- ``local_idx`` padding points at that last slot and ``vals`` padding is
+  0.0, so padded positions contribute exactly zero to scores and grads.
+- dummy examples have weight 0.0 and no features.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as globlib
+import random
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.parser import ParsedBlock
+
+
+@dataclasses.dataclass
+class DeviceBatch:
+    """One fixed-shape batch. Shapes: B examples, L feature slots per
+    example, U unique-row slots."""
+    labels: np.ndarray       # f32 [B]
+    weights: np.ndarray      # f32 [B]; 0.0 marks padded dummy examples
+    uniq_ids: np.ndarray     # i32 [U]; padded with pad_id, last slot pad
+    local_idx: np.ndarray    # i32 [B, L]; indexes uniq_ids; pad -> U-1
+    vals: np.ndarray         # f32 [B, L]; 0.0 padding
+    fields: Optional[np.ndarray] = None  # i32 [B, L]; 0 padding (FFM)
+    num_real: int = 0        # examples that are not padding
+
+    @property
+    def shape_key(self) -> Tuple[int, int, int, bool]:
+        return (len(self.labels), self.local_idx.shape[1],
+                len(self.uniq_ids), self.fields is not None)
+
+
+def expand_files(patterns: Sequence[str]) -> List[str]:
+    """File list with glob expansion, order-stable (reference configs list
+    globs/comma lists; SURVEY Appendix A)."""
+    out: List[str] = []
+    for p in patterns:
+        hits = sorted(globlib.glob(p))
+        if hits:
+            out.extend(hits)
+        else:
+            out.append(p)  # let open() raise -> loud failure on missing file
+    return out
+
+
+def _ladder_fit(n: int, ladder: Sequence[int]) -> int:
+    for b in ladder:
+        if n <= b:
+            return b
+    # beyond the configured ladder: next power of two, so arbitrarily long
+    # examples still get a (rarely recompiled) static bucket
+    b = ladder[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
+def _uniq_ladder(batch_size: int, max_l: int) -> List[int]:
+    """Power-of-two ladder for the unique-row bucket, capped at B*L + 1
+    (+1 guarantees a padding slot even when every id is distinct)."""
+    cap = batch_size * max_l + 1
+    out, b = [], 64
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(cap)
+    return out
+
+
+def make_device_batch(block: ParsedBlock, cfg: FmConfig,
+                      weights: Optional[np.ndarray] = None,
+                      batch_size: Optional[int] = None) -> DeviceBatch:
+    """CSR block -> fixed-shape DeviceBatch (pad + host-side unique)."""
+    B = batch_size or cfg.batch_size
+    n_real = block.batch_size
+    if n_real > B:
+        raise ValueError(f"block of {n_real} examples exceeds batch_size {B}")
+    sizes = block.sizes
+    max_l = int(sizes.max()) if n_real else 1
+    L = _ladder_fit(max(max_l, 1), cfg.bucket_ladder)
+
+    # Host-side unique (replaces the reference's in-graph tf.unique).
+    uniq, inverse = np.unique(block.ids, return_inverse=True)
+    U = _ladder_fit(len(uniq) + 1, _uniq_ladder(B, L))
+
+    uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
+    uniq_ids[:len(uniq)] = uniq
+    pad_slot = U - 1  # always a pad_id slot by construction
+
+    local_idx = np.full((B, L), pad_slot, dtype=np.int32)
+    vals = np.zeros((B, L), dtype=np.float32)
+    fields = (np.zeros((B, L), dtype=np.int32)
+              if block.fields is not None else None)
+    for e in range(n_real):
+        lo, hi = block.poses[e], block.poses[e + 1]
+        n = hi - lo
+        local_idx[e, :n] = inverse[lo:hi]
+        vals[e, :n] = block.vals[lo:hi]
+        if fields is not None:
+            fields[e, :n] = block.fields[lo:hi]
+
+    labels = np.zeros(B, dtype=np.float32)
+    labels[:n_real] = block.labels
+    w = np.zeros(B, dtype=np.float32)
+    if weights is not None:
+        w[:n_real] = np.asarray(weights, dtype=np.float32)[:n_real]
+    else:
+        w[:n_real] = 1.0
+    return DeviceBatch(labels=labels, weights=w, uniq_ids=uniq_ids,
+                       local_idx=local_idx, vals=vals, fields=fields,
+                       num_real=n_real)
+
+
+def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
+                shard_index: int, num_shards: int,
+                keep_empty: bool = False) -> Iterator[Tuple[str, float]]:
+    """Yield (line, weight) pairs, sharded by global line index so N
+    data-parallel processes see disjoint examples (the reference shards by
+    giving workers disjoint file lists; index-sharding also balances a
+    single big file)."""
+    wf = list(weight_files) if weight_files else [None] * len(files)
+    if weight_files and len(weight_files) != len(files):
+        raise ValueError("weight_files must parallel train_files "
+                         f"({len(weight_files)} vs {len(files)})")
+    idx = 0
+    for path, wpath in zip(files, wf):
+        wfh = open(wpath) if wpath else None
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    wline = wfh.readline() if wfh else ""
+                    if not line.strip() and not keep_empty:
+                        continue
+                    if idx % num_shards == shard_index:
+                        yield line, float(wline) if wline.strip() else 1.0
+                    idx += 1
+        finally:
+            if wfh:
+                wfh.close()
+
+
+def batch_iterator(cfg: FmConfig, files: Sequence[str],
+                   training: bool = True,
+                   weight_files: Sequence[str] = (),
+                   shard_index: int = 0, num_shards: int = 1,
+                   epochs: Optional[int] = None,
+                   batch_size: Optional[int] = None,
+                   seed: Optional[int] = None,
+                   keep_empty: bool = False) -> Iterator[DeviceBatch]:
+    """Epoch/shuffle/batch loop over text files.
+
+    Shuffling is a bounded reservoir of ``cfg.queue_size`` lines, the same
+    memory/coverage contract as the reference's shuffle queue (SURVEY §2
+    "Input pipeline"); deterministic given ``seed``.
+    """
+    from fast_tffm_tpu.data.parser import parse_lines
+    from fast_tffm_tpu.data.cparser import parse_lines_fast
+
+    files = expand_files(files)
+    B = batch_size or cfg.batch_size
+    n_epochs = epochs if epochs is not None else (cfg.epoch_num if training
+                                                  else 1)
+    rng = random.Random(cfg.seed if seed is None else seed)
+    do_shuffle = training and cfg.shuffle
+    # keep_empty needs blank lines to become zero-feature examples; only
+    # the Python parser implements that.
+    parse = (None if cfg.model_type == "ffm" or keep_empty
+             else parse_lines_fast)
+
+    for _ in range(n_epochs):
+        pending: List[Tuple[str, float]] = []
+        buf: List[Tuple[str, float]] = []
+
+        def flush_batches(done: bool):
+            while len(pending) >= B or (done and pending):
+                chunk = pending[:B]
+                del pending[:B]
+                lines = [c[0] for c in chunk]
+                w = np.array([c[1] for c in chunk], dtype=np.float32)
+                block = _parse_block(lines, cfg, parse, keep_empty)
+                yield make_device_batch(block, cfg, weights=w, batch_size=B)
+
+        for item in _iter_lines(files, weight_files if training else (),
+                                shard_index, num_shards,
+                                keep_empty=keep_empty):
+            if do_shuffle:
+                buf.append(item)
+                if len(buf) >= max(cfg.queue_size, B):
+                    j = rng.randrange(len(buf))
+                    buf[j], buf[-1] = buf[-1], buf[j]
+                    pending.append(buf.pop())
+            else:
+                pending.append(item)
+            yield from flush_batches(False)
+        if do_shuffle and buf:
+            rng.shuffle(buf)
+            pending.extend(buf)
+        yield from flush_batches(True)
+
+
+def _parse_block(lines: Sequence[str], cfg: FmConfig, fast_parse,
+                 keep_empty: bool = False) -> ParsedBlock:
+    from fast_tffm_tpu.data.parser import parse_lines
+    field_aware = cfg.model_type == "ffm"
+    if fast_parse is not None:
+        try:
+            return fast_parse(
+                lines, cfg.vocabulary_size,
+                hash_feature_id=cfg.hash_feature_id,
+                max_features_per_example=cfg.max_features_per_example)
+        except (OSError, RuntimeError):
+            pass  # C++ extension unavailable -> Python fallback
+    return parse_lines(
+        lines, cfg.vocabulary_size, hash_feature_id=cfg.hash_feature_id,
+        field_aware=field_aware, field_num=cfg.field_num,
+        max_features_per_example=cfg.max_features_per_example,
+        keep_empty=keep_empty)

@@ -1,0 +1,87 @@
+"""Training metrics: streaming AUC and throughput.
+
+The reference logs only ``step, loss`` (SURVEY.md §5 "Metrics"); the
+north-star metric adds test-AUC and examples/sec/chip (BASELINE.json), so
+both are first-class here. AUC is the histogram/binned estimator (the same
+approach as TF's AUC metric): O(1) memory, streaming, deterministic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sigmoid(x: np.ndarray) -> np.ndarray:
+    out = np.empty_like(x, dtype=np.float64)
+    pos = x >= 0
+    out[pos] = 1.0 / (1.0 + np.exp(-x[pos]))
+    ex = np.exp(x[~pos])
+    out[~pos] = ex / (1.0 + ex)
+    return out
+
+
+class StreamingAUC:
+    """Binned Mann-Whitney AUC over sigmoid-squashed scores in [0, 1].
+
+    update() takes raw scores (logits) and {0,1} labels; ties within a bin
+    contribute 1/2 (trapezoidal), so with enough bins this converges to the
+    exact rank statistic. Weights: examples with weight 0 (batch padding)
+    are dropped; other weights scale their example's contribution.
+    """
+
+    def __init__(self, num_bins: int = 1 << 14):
+        self.num_bins = num_bins
+        self.pos = np.zeros(num_bins, dtype=np.float64)
+        self.neg = np.zeros(num_bins, dtype=np.float64)
+
+    def update(self, scores: np.ndarray, labels: np.ndarray,
+               weights: np.ndarray | None = None) -> None:
+        scores = np.asarray(scores, dtype=np.float64).ravel()
+        labels = np.asarray(labels, dtype=np.float64).ravel()
+        w = (np.ones_like(scores) if weights is None
+             else np.asarray(weights, dtype=np.float64).ravel())
+        keep = w > 0
+        scores, labels, w = scores[keep], labels[keep], w[keep]
+        p = sigmoid(scores)
+        bins = np.minimum((p * self.num_bins).astype(np.int64),
+                          self.num_bins - 1)
+        is_pos = labels >= 0.5
+        np.add.at(self.pos, bins[is_pos], w[is_pos])
+        np.add.at(self.neg, bins[~is_pos], w[~is_pos])
+
+    def result(self) -> float:
+        """AUC = P(score_pos > score_neg) + 0.5 P(tie)."""
+        n_pos = self.pos.sum()
+        n_neg = self.neg.sum()
+        if n_pos == 0 or n_neg == 0:
+            return float("nan")
+        neg_below = np.cumsum(self.neg) - self.neg   # negatives in lower bins
+        pairs = np.sum(self.pos * (neg_below + 0.5 * self.neg))
+        return float(pairs / (n_pos * n_neg))
+
+    def reset(self) -> None:
+        self.pos[:] = 0.0
+        self.neg[:] = 0.0
+
+
+def exact_auc(scores: np.ndarray, labels: np.ndarray) -> float:
+    """O(n log n) exact AUC — test oracle for StreamingAUC."""
+    scores = np.asarray(scores, dtype=np.float64).ravel()
+    labels = np.asarray(labels, dtype=np.float64).ravel() >= 0.5
+    order = np.argsort(scores, kind="mergesort")
+    s, y = scores[order], labels[order]
+    n = len(s)
+    # average ranks with tie handling
+    ranks = np.empty(n, dtype=np.float64)
+    i = 0
+    while i < n:
+        j = i
+        while j + 1 < n and s[j + 1] == s[i]:
+            j += 1
+        ranks[i:j + 1] = 0.5 * (i + j) + 1.0
+        i = j + 1
+    n_pos = int(y.sum())
+    n_neg = n - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return float("nan")
+    return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))

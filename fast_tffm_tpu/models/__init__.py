@@ -1,0 +1,1 @@
+from fast_tffm_tpu.models import oracle  # noqa: F401

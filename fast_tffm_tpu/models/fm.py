@@ -1,0 +1,180 @@
+"""Model assembly: table init, scoring, loss, and the jitted train step.
+
+This is the analogue of the reference's in-driver graph build (SURVEY.md
+§3.1): gather unique rows -> scorer -> loss + reg -> Adagrad sparse apply.
+The whole step is one ``jax.jit`` so, like the reference's single
+``sess.run`` per step, Python touches nothing per-step but the loop.
+
+Differences from the reference, by design (SURVEY §7):
+- updates are synchronous (no async PS staleness),
+- batches are fixed-shape/bucketed, deduplicated on the host,
+- the optimizer is a hand-rolled *sparse* Adagrad: full-size accumulator
+  (row-sharded like the table in parallel/), but per-step work touches
+  only the batch's unique rows — the equivalent of TF's
+  ``sparse_apply_adagrad`` on IndexedSlices (SURVEY §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import DeviceBatch
+from fast_tffm_tpu.ops.interaction import (batch_reg, ffm_batch_scores,
+                                           fm_batch_scores)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """The static (hashable) subset of FmConfig the jitted step closes
+    over; one compiled executable per (spec, batch shape bucket)."""
+    model_type: str
+    order: int
+    factor_num: int
+    field_num: int
+    vocabulary_size: int
+    loss_type: str
+    factor_lambda: float
+    bias_lambda: float
+    learning_rate: float
+    kernel: str = "xla"
+
+    @classmethod
+    def from_config(cls, cfg: FmConfig) -> "ModelSpec":
+        return cls(model_type=cfg.model_type, order=cfg.order,
+                   factor_num=cfg.factor_num, field_num=cfg.field_num,
+                   vocabulary_size=cfg.vocabulary_size,
+                   loss_type=cfg.loss_type, factor_lambda=cfg.factor_lambda,
+                   bias_lambda=cfg.bias_lambda,
+                   learning_rate=cfg.learning_rate, kernel=cfg.kernel)
+
+    @property
+    def row_dim(self) -> int:
+        if self.model_type == "ffm":
+            return self.factor_num * self.field_num + 1
+        return self.factor_num + 1
+
+
+def init_table(cfg: FmConfig, seed: int = 0) -> jax.Array:
+    """[vocab+1, D] uniform(-init_value_range, +init_value_range) — the
+    reference's init (SURVEY §2 "Model parameters") — with the final
+    padding row zeroed (it must stay dead)."""
+    key = jax.random.PRNGKey(seed)
+    t = jax.random.uniform(
+        key, (cfg.num_rows, cfg.row_dim), dtype=jnp.float32,
+        minval=-cfg.init_value_range, maxval=cfg.init_value_range)
+    return t.at[-1].set(0.0)
+
+
+def init_accumulator(cfg: FmConfig) -> jax.Array:
+    """Adagrad accumulator, full table size, constant-initialised (TF
+    Adagrad's initial_accumulator_value; cfg.adagrad_init)."""
+    return jnp.full((cfg.num_rows, cfg.row_dim), cfg.adagrad_init,
+                    dtype=jnp.float32)
+
+
+def _scores(spec: ModelSpec, gathered: jax.Array, local_idx: jax.Array,
+            vals: jax.Array, fields: Optional[jax.Array]) -> jax.Array:
+    if spec.model_type == "ffm":
+        return ffm_batch_scores(gathered, spec.field_num, local_idx,
+                                fields, vals)
+    if spec.kernel == "pallas" and spec.order == 2:
+        from fast_tffm_tpu.ops.pallas_fm import fm_batch_scores_pallas
+        return fm_batch_scores_pallas(gathered, local_idx, vals)
+    return fm_batch_scores(gathered, local_idx, vals, order=spec.order)
+
+
+def _per_example_loss(spec: ModelSpec, scores: jax.Array,
+                      labels: jax.Array) -> jax.Array:
+    if spec.loss_type == "logistic":
+        # Stable sigmoid cross-entropy with {0,1} labels (the reference's
+        # classification loss; SURVEY §2 "Loss + optimizer").
+        return (jnp.maximum(scores, 0.0) - scores * labels
+                + jnp.log1p(jnp.exp(-jnp.abs(scores))))
+    return jnp.square(scores - labels)
+
+
+def loss_and_scores(spec: ModelSpec, gathered: jax.Array,
+                    labels: jax.Array, weights: jax.Array,
+                    uniq_ids: jax.Array, local_idx: jax.Array,
+                    vals: jax.Array, fields: Optional[jax.Array]
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Weighted-mean data loss + batch-active L2 reg. Zero-weight padding
+    examples drop out of both value and gradient."""
+    scores = _scores(spec, gathered, local_idx, vals, fields)
+    per = _per_example_loss(spec, scores, labels)
+    wsum = jnp.maximum(weights.sum(), 1.0)
+    data_loss = (per * weights).sum() / wsum
+    reg = batch_reg(gathered, uniq_ids, spec.vocabulary_size,
+                    spec.factor_lambda, spec.bias_lambda)
+    return data_loss + reg, scores
+
+
+def sparse_adagrad_apply(table: jax.Array, acc: jax.Array,
+                         uniq_ids: jax.Array, grad_rows: jax.Array,
+                         lr: float) -> Tuple[jax.Array, jax.Array]:
+    """acc[rows] += g²; table[rows] -= lr * g / sqrt(acc[rows]).
+
+    ``uniq_ids`` are unique except padding slots, whose gradient rows are
+    already masked to zero, so duplicate scatter-adds at the dead row are
+    no-ops and the dense-Adagrad semantics on touched rows are exact.
+    """
+    acc = acc.at[uniq_ids].add(jnp.square(grad_rows))
+    upd = -lr * grad_rows * lax.rsqrt(acc[uniq_ids])
+    return table.at[uniq_ids].add(upd), acc
+
+
+@functools.lru_cache(maxsize=None)
+def make_train_step(spec: ModelSpec):
+    """Build the jitted train step. Signature:
+    (table, acc, labels, weights, uniq_ids, local_idx, vals, fields)
+      -> (table, acc, loss, scores)
+    Buffers are donated; one executable per batch-shape bucket. Cached per
+    spec so repeated train()/evaluate() calls reuse compiled code."""
+
+    def step(table, acc, labels, weights, uniq_ids, local_idx, vals,
+             fields=None):
+        gathered = table[uniq_ids]
+
+        def loss_fn(g):
+            return loss_and_scores(spec, g, labels, weights, uniq_ids,
+                                   local_idx, vals, fields)
+
+        (loss, scores), grad = jax.value_and_grad(
+            loss_fn, has_aux=True)(gathered)
+        live = (uniq_ids < spec.vocabulary_size).astype(grad.dtype)[:, None]
+        grad = grad * live
+        table, acc = sparse_adagrad_apply(table, acc, uniq_ids, grad,
+                                          spec.learning_rate)
+        return table, acc, loss, scores
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=None)
+def make_score_fn(spec: ModelSpec):
+    """Jitted inference: (table, uniq_ids, local_idx, vals, fields) ->
+    raw scores [B] (the predict driver applies sigmoid for logistic).
+    Cached per spec — callers may re-request it per file/epoch."""
+
+    def score(table, uniq_ids, local_idx, vals, fields=None):
+        gathered = table[uniq_ids]
+        return _scores(spec, gathered, local_idx, vals, fields)
+
+    return jax.jit(score)
+
+
+def batch_args(batch: DeviceBatch) -> Dict[str, np.ndarray]:
+    args = dict(labels=batch.labels, weights=batch.weights,
+                uniq_ids=batch.uniq_ids, local_idx=batch.local_idx,
+                vals=batch.vals)
+    if batch.fields is not None:
+        args["fields"] = batch.fields
+    return args

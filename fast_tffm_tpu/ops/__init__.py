@@ -1,0 +1,2 @@
+from fast_tffm_tpu.ops.interaction import (  # noqa: F401
+    fm_batch_scores, ffm_batch_scores, batch_reg, gather_rows)

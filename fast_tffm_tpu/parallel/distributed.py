@@ -1,0 +1,41 @@
+"""Multi-process bring-up — the TF1 ``ClusterSpec``/``Server`` equivalent.
+
+The reference builds a gRPC cluster from the config's ``[Cluster]``
+``ps_hosts``/``worker_hosts`` and runs async PS training (SURVEY.md §3.2/
+§3.3). The TPU-native replacement is ``jax.distributed.initialize``: every
+worker is a JAX process in one synchronous SPMD job; XLA collectives over
+ICI/DCN replace gRPC parameter traffic; there are no ps roles (the table
+is row-sharded across the mesh, parallel/sharded.py).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from fast_tffm_tpu.config import FmConfig
+
+
+def init_from_cluster(cfg: FmConfig, job_name: str,
+                      task_index: int) -> Tuple[int, int]:
+    """Map the reference's ``dist_train worker <i>`` identity onto a
+    jax.distributed process. Returns (data_shard_index, num_shards) for
+    the input pipeline. Worker 0's host doubles as the coordinator (the
+    analogue of the reference's chief worker; SURVEY §3.2)."""
+    if job_name != "worker":
+        raise ValueError(f"unsupported job_name {job_name!r}; only "
+                         "'worker' exists in the TPU rebuild")
+    hosts = cfg.worker_hosts
+    if len(hosts) <= 1:
+        return 0, 1
+    if not 0 <= task_index < len(hosts):
+        raise ValueError(f"task_index {task_index} out of range for "
+                         f"{len(hosts)} worker_hosts")
+    # Gradient/table synchronization across processes rides the sharded
+    # train step (parallel/sharded.py) under a global mesh; until the
+    # train driver wires that in for multi-process runs, refusing is
+    # strictly better than N silently-independent replicas racing on one
+    # checkpoint directory.
+    raise NotImplementedError(
+        "multi-process dist_train is not wired up yet: single-process "
+        "multi-device training (one host of a TPU slice) is supported via "
+        "the sharded train step; run one process or shard files manually")

@@ -1,0 +1,74 @@
+"""Predict driver — the ``py/fm_predict.py`` equivalent (SURVEY.md §3.4).
+
+Restores the latest checkpoint at the config's ``model_file``, streams the
+predict files through parser + scorer, and writes one score per input
+line, order-preserving — sigmoid-transformed for logistic loss, raw for
+mse. ``score_path`` is treated as a directory; each input file ``f``
+produces ``<score_path>/<basename(f)>.score``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from fast_tffm_tpu.checkpoint import CheckpointState
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import batch_iterator, expand_files
+from fast_tffm_tpu.metrics import sigmoid
+from fast_tffm_tpu.models.fm import ModelSpec, batch_args, make_score_fn
+from fast_tffm_tpu.utils.logging import get_logger
+
+
+def load_table(cfg: FmConfig) -> jax.Array:
+    import jax.numpy as jnp
+    from fast_tffm_tpu.train import checkpoint_template
+    ckpt = CheckpointState(cfg.model_file)
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    ckpt.close()
+    if restored is None:
+        raise FileNotFoundError(
+            f"no checkpoint found under {cfg.model_file}.ckpt "
+            "(run training first)")
+    return jnp.asarray(np.asarray(restored["table"]), dtype=jnp.float32)
+
+
+def predict_scores(cfg: FmConfig, table: jax.Array,
+                   files) -> np.ndarray:
+    """Raw scores for every example in ``files``, in input order."""
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_score_fn(spec)
+    out: List[np.ndarray] = []
+    # keep_empty: blank input lines become zero-feature examples so the
+    # score file stays line-aligned with the input (SURVEY §3.4).
+    for batch in batch_iterator(cfg, files, training=False, epochs=1,
+                                keep_empty=True):
+        args = batch_args(batch)
+        args.pop("labels"), args.pop("weights")
+        scores = np.asarray(score_fn(table, **args))
+        out.append(scores[:batch.num_real])
+    return (np.concatenate(out) if out
+            else np.zeros(0, dtype=np.float32))
+
+
+def predict(cfg: FmConfig, table: Optional[jax.Array] = None) -> List[str]:
+    """Run batch prediction; returns the list of score files written."""
+    logger = get_logger(log_file=cfg.log_file or None)
+    if table is None:
+        table = load_table(cfg)
+    os.makedirs(cfg.score_path, exist_ok=True)
+    written = []
+    for path in expand_files(cfg.predict_files):
+        raw = predict_scores(cfg, table, [path])
+        vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
+        out_path = os.path.join(cfg.score_path,
+                                os.path.basename(path) + ".score")
+        with open(out_path, "w") as fh:
+            for v in vals:
+                fh.write(f"{v:.6f}\n")
+        logger.info("wrote %d scores to %s", len(vals), out_path)
+        written.append(out_path)
+    return written

@@ -1,0 +1,122 @@
+"""Train driver — the ``py/fm_train.py`` equivalent (SURVEY.md §3.1/§3.2).
+
+Single-process: build state, jit the step, run the hot loop (one device
+dispatch per step, Python only loops and logs — the property the
+reference gets from ``sess.run`` it gets here from ``jax.jit``).
+
+Distributed: where the reference launches ps/worker roles over TF1's gRPC
+runtime with *async* SGD, this framework is synchronous data-parallel over
+a device mesh (parallel/), with the table row-sharded across it; the
+``dist_train <job> <idx>`` CLI surface is accepted and mapped onto
+``jax.distributed`` (parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from fast_tffm_tpu.checkpoint import CheckpointState, export_npz
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import batch_iterator
+from fast_tffm_tpu.metrics import StreamingAUC
+from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
+                                     init_table, make_score_fn,
+                                     make_train_step)
+from fast_tffm_tpu.utils.logging import get_logger
+from fast_tffm_tpu.utils.timing import StepTimer
+
+
+def evaluate(cfg: FmConfig, table: jax.Array, files,
+             max_batches: Optional[int] = None) -> Tuple[float, int]:
+    """Streamed AUC over ``files``; returns (auc, n_examples)."""
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_score_fn(spec)
+    auc = StreamingAUC()
+    n = 0
+    for batch in batch_iterator(cfg, files, training=False, epochs=1):
+        args = batch_args(batch)
+        args.pop("labels"), args.pop("weights")
+        scores = np.asarray(score_fn(table, **args))
+        auc.update(scores[:batch.num_real], batch.labels[:batch.num_real])
+        n += batch.num_real
+        if max_batches and n >= max_batches * cfg.batch_size:
+            break
+    return auc.result(), n
+
+
+def train(cfg: FmConfig, job_name: Optional[str] = None,
+          task_index: Optional[int] = None) -> jax.Array:
+    """Run training per config; returns the final table (host-fetchable).
+
+    ``job_name``/``task_index`` mirror the reference's ``dist_train``
+    argv (SURVEY §3.2); in multi-process mode they identify this process
+    in the jax.distributed cluster.
+    """
+    logger = get_logger(log_file=cfg.log_file or None)
+    shard_index, num_shards = 0, 1
+    if job_name is not None:
+        from fast_tffm_tpu.parallel.distributed import init_from_cluster
+        shard_index, num_shards = init_from_cluster(cfg, job_name,
+                                                    task_index or 0)
+
+    spec = ModelSpec.from_config(cfg)
+    table = init_table(cfg, cfg.seed)
+    acc = init_accumulator(cfg)
+    ckpt = CheckpointState(cfg.model_file)
+    global_step = 0
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    if restored is not None:
+        table = jax.device_put(jnp_like(restored["table"], table))
+        acc = jax.device_put(jnp_like(restored["acc"], acc))
+        global_step = int(restored["step"])
+        logger.info("restored checkpoint at step %d", global_step)
+
+    step_fn = make_train_step(spec)
+    timer = StepTimer()
+    loss = None
+    loss_val = float("nan")
+    for epoch in range(cfg.epoch_num):
+        for batch in batch_iterator(cfg, cfg.train_files, training=True,
+                                    weight_files=cfg.weight_files,
+                                    shard_index=shard_index,
+                                    num_shards=num_shards, epochs=1,
+                                    seed=cfg.seed + epoch):
+            table, acc, loss, _ = step_fn(table, acc, **batch_args(batch))
+            global_step += 1
+            timer.tick(batch.num_real)
+            if cfg.log_steps and global_step % cfg.log_steps == 0:
+                loss_val = float(loss)
+                logger.info(
+                    "step %d epoch %d loss %.6f examples/sec %.0f",
+                    global_step, epoch, loss_val, timer.examples_per_sec)
+            if cfg.save_steps and global_step % cfg.save_steps == 0:
+                ckpt.save(global_step, table, acc)
+        if cfg.validation_files:
+            auc, n = evaluate(cfg, table, cfg.validation_files)
+            logger.info("epoch %d validation AUC %.6f over %d examples",
+                        epoch, auc, n)
+    loss_val = float(loss) if loss is not None else loss_val
+    ckpt.save(global_step, table, acc, force=True)
+    export_npz(table, cfg.model_file + ".npz")
+    logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
+                global_step, loss_val, timer.examples_per_sec)
+    ckpt.close()
+    return table
+
+
+def jnp_like(host_arr, like: jax.Array):
+    import jax.numpy as jnp
+    return jnp.asarray(np.asarray(host_arr), dtype=like.dtype)
+
+
+def checkpoint_template(cfg: FmConfig):
+    """Abstract pytree matching CheckpointState.save's layout — orbax
+    needs it to restore from a process that didn't do the saving."""
+    shape = (cfg.num_rows, cfg.row_dim)
+    return {"table": jax.ShapeDtypeStruct(shape, np.float32),
+            "acc": jax.ShapeDtypeStruct(shape, np.float32),
+            "step": 0}

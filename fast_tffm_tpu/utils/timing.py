@@ -1,0 +1,55 @@
+"""Step timing + profiling hooks.
+
+The reference has no project-owned profiling (SURVEY.md §5 "Tracing");
+here every train step can be wrapped in a ``jax.profiler`` trace
+annotation and throughput is measured with ``block_until_ready`` fences.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class StepTimer:
+    """Examples/sec over a sliding window of completed steps; call
+    ``tick(n_examples)`` after each step result is materialised."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._examples = 0
+        self._steps = 0
+
+    def tick(self, n_examples: int) -> None:
+        self._examples += n_examples
+        self._steps += 1
+
+    @property
+    def examples_per_sec(self) -> float:
+        dt = time.perf_counter() - self._t0
+        return self._examples / dt if dt > 0 else 0.0
+
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+
+@contextlib.contextmanager
+def trace_span(name: str):
+    """jax.profiler annotation; shows up in TensorBoard/Perfetto traces."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def profile_to(log_dir: str):
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""CLI-compatible entrypoint — the reference's ``run_tffm.py`` surface
+(SURVEY.md §1 L1, §3):
+
+    python run_tffm.py train   <cfg>
+    python run_tffm.py train   <cfg> dist_train <job_name> <task_index>
+    python run_tffm.py predict <cfg>
+
+``dist_train`` roles map onto synchronous jax.distributed processes
+instead of TF1 ps/worker async-SGD (SURVEY §7): ``worker i`` becomes DP
+process i; a ``ps`` role is accepted and exits with an explanatory
+message, since parameter serving is subsumed by the row-sharded table.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from fast_tffm_tpu.config import load_config
+
+
+def _usage() -> int:
+    print(__doc__, file=sys.stderr)
+    return 2
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 2 or argv[0] not in ("train", "predict"):
+        return _usage()
+    mode, cfg_path = argv[0], argv[1]
+    rest = argv[2:]
+    cfg = load_config(cfg_path)
+
+    if mode == "predict":
+        if rest:
+            return _usage()
+        from fast_tffm_tpu.predict import predict
+        predict(cfg)
+        return 0
+
+    job_name = task_index = None
+    if rest:
+        if len(rest) != 3 or rest[0] != "dist_train":
+            return _usage()
+        job_name, task_index = rest[1], int(rest[2])
+        if job_name == "ps":
+            print("fast_tffm_tpu has no parameter servers: the table is "
+                  "row-sharded across the device mesh. Launch worker "
+                  "roles only.", file=sys.stderr)
+            return 0
+        if job_name != "worker":
+            return _usage()
+
+    from fast_tffm_tpu.train import train
+    train(cfg, job_name, task_index)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

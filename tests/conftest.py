@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device CPU platform BEFORE jax initialises.
+
+SURVEY.md §4: the honest JAX analogue of the reference's "localhost PS
+cluster" smoke tests is a single-host fake mesh via
+``--xla_force_host_platform_device_count``. Everything in tests/ runs on
+CPU so the suite is hermetic and fast; TPU-only paths (real Pallas
+lowering) are exercised by bench.py / the driver on hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,93 @@
+import textwrap
+
+import pytest
+
+from fast_tffm_tpu.config import FmConfig, load_config
+
+
+def write_cfg(tmp_path, body):
+    p = tmp_path / "test.cfg"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_reference_schema_roundtrip(tmp_path):
+    # The reference's sample.cfg shape (SURVEY Appendix A) parses as-is.
+    path = write_cfg(tmp_path, """
+        [General]
+        vocabulary_size = 80000
+        vocabulary_block_num = 4
+        hash_feature_id = True
+        factor_num = 8
+        model_file = ./model/fm_model
+        log_file = ./log/fm.log
+
+        [Train]
+        train_files = data/a.txt, data/b.txt
+        epoch_num = 10
+        batch_size = 10000
+        learning_rate = 0.01
+        factor_lambda = 1e-5
+        bias_lambda = 1e-5
+        init_value_range = 0.01
+        loss_type = logistic
+
+        [Predict]
+        predict_files = data/test.txt
+        score_path = ./score/
+
+        [Cluster]
+        ps_hosts = h1:2220,h2:2220
+        worker_hosts = h3:2230,h4:2230
+    """)
+    cfg = load_config(path)
+    assert cfg.vocabulary_size == 80000
+    assert cfg.hash_feature_id is True
+    assert cfg.factor_num == 8
+    assert cfg.train_files == ("data/a.txt", "data/b.txt")
+    assert cfg.epoch_num == 10
+    assert cfg.batch_size == 10000
+    assert cfg.factor_lambda == pytest.approx(1e-5)
+    assert cfg.worker_hosts == ("h3:2230", "h4:2230")
+    assert cfg.row_dim == 9
+    assert cfg.pad_id == 80000
+
+
+def test_unknown_key_fails_loudly(tmp_path):
+    path = write_cfg(tmp_path, """
+        [General]
+        vocabulary_sizee = 100
+    """)
+    with pytest.raises(KeyError):
+        load_config(path)
+
+
+def test_missing_file():
+    with pytest.raises(FileNotFoundError):
+        load_config("/nonexistent/x.cfg")
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        FmConfig(order=1)
+    with pytest.raises(ValueError):
+        FmConfig(model_type="ffm")          # needs field_num
+    with pytest.raises(ValueError):
+        FmConfig(model_type="nope")
+    with pytest.raises(ValueError):
+        FmConfig(loss_type="hinge")
+    ffm = FmConfig(model_type="ffm", field_num=5, factor_num=4)
+    assert ffm.row_dim == 21
+
+
+def test_extension_keys(tmp_path):
+    path = write_cfg(tmp_path, """
+        [General]
+        model_type = ffm
+        field_num = 3
+        factor_num = 2
+        order = 2
+    """)
+    cfg = load_config(path)
+    assert cfg.model_type == "ffm"
+    assert cfg.row_dim == 7

@@ -1,0 +1,60 @@
+"""Golden MurmurHash64A values — pin the hash forever.
+
+If these change, every trained model's row assignment silently shifts
+(SURVEY §7 hard part #5), so they are locked to explicit constants.
+"""
+
+from fast_tffm_tpu.data.hashing import hash_feature, murmur64
+
+
+# Self-consistent goldens computed once from the reference Python
+# implementation of MurmurHash64A (seed 0) and frozen.
+GOLDENS = {
+    b"": 0x0000000000000000,
+    b"a": 0x071717D2D36B6B11,
+    b"ab": 0x62BE85B2FE53D1F8,
+    b"abc": 0x9CC9C33498A95EFB,
+    b"abcdefgh": 0xAFDB0257FF41AA98,
+    b"abcdefghi": 0xC9B9D84356146AC2,
+    b"1234567890abcdef": 0xE087B8DB03D15846,
+    b"feature:42": 0x98D61945C6B545B2,
+}
+
+
+def test_empty():
+    assert murmur64(b"") == 0
+
+
+def test_mixing_and_determinism():
+    seen = set()
+    for s in [b"", b"a", b"b", b"aa", b"ab", b"ba", b"feature_1",
+              b"feature_2", b"x" * 100]:
+        h = murmur64(s)
+        assert 0 <= h < (1 << 64)
+        assert h == murmur64(s)
+        seen.add(h)
+    assert len(seen) == 9  # no collisions among these
+
+
+def test_goldens_locked():
+    for data, expect in GOLDENS.items():
+        got = murmur64(data)
+        assert got == expect, (
+            f"murmur64({data!r}) = {got:#018x}, expected {expect:#018x} — "
+            "the hash changed; this breaks every existing model!")
+
+
+def test_hash_feature_range():
+    for v in (1, 7, 1000, 10**9):
+        for s in ("a", "b", "click_id=123", ""):
+            assert 0 <= hash_feature(s, v) < v
+
+
+def test_distribution_roughly_uniform():
+    import numpy as np
+    n, buckets = 20000, 16
+    counts = np.zeros(buckets)
+    for i in range(n):
+        counts[hash_feature(f"feat_{i}", buckets)] += 1
+    assert counts.min() > n / buckets * 0.8
+    assert counts.max() < n / buckets * 1.2

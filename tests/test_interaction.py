@@ -1,0 +1,111 @@
+"""Device math (ops/interaction.py) vs the NumPy oracle, through the real
+pipeline (bucketed padding, host-side unique)."""
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.parser import ParsedBlock
+from fast_tffm_tpu.data.pipeline import make_device_batch
+from fast_tffm_tpu.models import oracle
+from fast_tffm_tpu.models.fm import ModelSpec
+from fast_tffm_tpu.ops.interaction import (batch_reg, ffm_batch_scores,
+                                           fm_batch_scores, gather_rows)
+
+V, K = 50, 4
+
+
+def random_batch(rng, n, max_nnz=6, with_fields=False, field_num=3):
+    examples, blocks = [], dict(labels=[], poses=[0], ids=[], vals=[],
+                                fields=[])
+    for _ in range(n):
+        nnz = int(rng.integers(1, max_nnz + 1))
+        ids = rng.choice(V, size=nnz, replace=False)
+        vals = rng.normal(size=nnz)
+        blocks["labels"].append(float(rng.integers(0, 2)))
+        blocks["ids"].extend(ids.tolist())
+        blocks["vals"].extend(vals.tolist())
+        blocks["poses"].append(len(blocks["ids"]))
+        if with_fields:
+            flds = rng.integers(0, field_num, size=nnz)
+            blocks["fields"].extend(flds.tolist())
+            examples.append((ids.tolist(), flds.tolist(), vals.tolist()))
+        else:
+            examples.append((ids.tolist(), vals.tolist()))
+    block = ParsedBlock(
+        labels=np.array(blocks["labels"], np.float32),
+        poses=np.array(blocks["poses"], np.int32),
+        ids=np.array(blocks["ids"], np.int32),
+        vals=np.array(blocks["vals"], np.float32),
+        fields=(np.array(blocks["fields"], np.int32) if with_fields
+                else None))
+    return examples, block
+
+
+def make_cfg(**kw):
+    kw.setdefault("vocabulary_size", V)
+    kw.setdefault("factor_num", K)
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("bucket_ladder", (8,))
+    return FmConfig(**kw)
+
+
+def padded_table(rng, cfg):
+    t = rng.normal(size=(cfg.num_rows, cfg.row_dim)).astype(np.float32) * 0.3
+    t[-1] = 0.0
+    return t
+
+
+@pytest.mark.parametrize("order", [2, 3])
+def test_scores_match_oracle(rng, order):
+    cfg = make_cfg(order=order)
+    examples, block = random_batch(rng, 5)
+    b = make_device_batch(block, cfg)
+    table = padded_table(rng, cfg)
+    gathered = gather_rows(table, b.uniq_ids)
+    got = np.asarray(fm_batch_scores(gathered, b.local_idx, b.vals,
+                                     order=order))
+    want = oracle.batch_scores(table[:-1].astype(np.float64), examples,
+                               order=order)
+    np.testing.assert_allclose(got[:b.num_real], want, rtol=2e-4, atol=2e-4)
+    # padded dummy examples score exactly 0
+    np.testing.assert_array_equal(got[b.num_real:], 0.0)
+
+
+def test_ffm_scores_match_oracle(rng):
+    field_num = 3
+    cfg = make_cfg(model_type="ffm", field_num=field_num)
+    examples, block = random_batch(rng, 4, with_fields=True,
+                                   field_num=field_num)
+    b = make_device_batch(block, cfg)
+    table = padded_table(rng, cfg)
+    gathered = gather_rows(table, b.uniq_ids)
+    got = np.asarray(ffm_batch_scores(gathered, field_num, b.local_idx,
+                                      b.fields, b.vals))
+    want = np.array([
+        oracle.ffm_score(table[:-1].astype(np.float64), field_num, i, f, x)
+        for i, f, x in examples])
+    np.testing.assert_allclose(got[:b.num_real], want, rtol=2e-4, atol=2e-4)
+
+
+def test_reg_matches_oracle(rng):
+    cfg = make_cfg()
+    examples, block = random_batch(rng, 5)
+    b = make_device_batch(block, cfg)
+    table = padded_table(rng, cfg)
+    gathered = gather_rows(table, b.uniq_ids)
+    got = float(batch_reg(gathered, b.uniq_ids, V, 0.1, 0.05))
+    want = oracle.regularization(table[:-1].astype(np.float64),
+                                 examples, 0.1, 0.05)
+    assert got == pytest.approx(want, rel=1e-4)
+
+
+def test_empty_example_scores_zero(rng):
+    cfg = make_cfg()
+    # one real example, rest padding; a dummy has no features
+    _, block = random_batch(rng, 1)
+    b = make_device_batch(block, cfg)
+    table = padded_table(rng, cfg)
+    got = np.asarray(fm_batch_scores(gather_rows(table, b.uniq_ids),
+                                     b.local_idx, b.vals))
+    assert np.all(got[1:] == 0.0)

@@ -1,0 +1,52 @@
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.metrics import StreamingAUC, exact_auc, sigmoid
+
+
+def test_sigmoid_stable():
+    x = np.array([-1000.0, -1.0, 0.0, 1.0, 1000.0])
+    s = sigmoid(x)
+    assert s[0] == 0.0 and s[-1] == 1.0
+    assert s[2] == pytest.approx(0.5)
+    assert np.all(np.diff(s) >= 0)
+
+
+def test_exact_auc_known_values():
+    assert exact_auc(np.array([0.1, 0.9]), np.array([0, 1])) == 1.0
+    assert exact_auc(np.array([0.9, 0.1]), np.array([0, 1])) == 0.0
+    assert exact_auc(np.array([0.5, 0.5]), np.array([0, 1])) == 0.5
+    # perfect separation among many
+    s = np.concatenate([np.arange(10), 100 + np.arange(10)])
+    y = np.concatenate([np.zeros(10), np.ones(10)])
+    assert exact_auc(s, y) == 1.0
+
+
+def test_streaming_matches_exact(rng):
+    scores = rng.normal(size=5000)
+    labels = (rng.uniform(size=5000) < sigmoid(scores * 0.7)).astype(float)
+    auc = StreamingAUC()
+    for i in range(0, 5000, 617):           # uneven chunks
+        auc.update(scores[i:i + 617], labels[i:i + 617])
+    assert auc.result() == pytest.approx(exact_auc(scores, labels),
+                                         abs=2e-3)
+
+
+def test_streaming_weights_drop_padding(rng):
+    scores = rng.normal(size=200)
+    labels = (rng.uniform(size=200) < 0.5).astype(float)
+    w = np.ones(200)
+    a = StreamingAUC()
+    a.update(scores, labels, w)
+    # adding zero-weight garbage must not change the result
+    b = StreamingAUC()
+    b.update(np.concatenate([scores, rng.normal(size=50)]),
+             np.concatenate([labels, np.ones(50)]),
+             np.concatenate([w, np.zeros(50)]))
+    assert a.result() == pytest.approx(b.result(), abs=1e-12)
+
+
+def test_degenerate_labels():
+    a = StreamingAUC()
+    a.update(np.array([0.5, 0.7]), np.array([1.0, 1.0]))
+    assert np.isnan(a.result())

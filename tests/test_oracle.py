@@ -1,0 +1,107 @@
+"""The oracle must be right before anything is tested against it: check it
+against brute-force definitions computed a completely different way."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.models import oracle
+
+
+def rand_table(rng, v=20, k=3):
+    return rng.normal(size=(v, k + 1)).astype(np.float64)
+
+
+def brute_force_fm(table, ids, vals, order):
+    """Direct sum over feature subsets of size 2..order (and the linear
+    term) — O(n^order), no clever identities."""
+    k = table.shape[1] - 1
+    score = sum(table[i, k] * x for i, x in zip(ids, vals))
+    n = len(ids)
+    for t in range(2, order + 1):
+        for combo in itertools.combinations(range(n), t):
+            prod = np.ones(k)
+            for j in combo:
+                prod = prod * table[ids[j], :k] * vals[j]
+            score += prod.sum()
+    return score
+
+
+def test_order2_identity_vs_brute_force(rng):
+    table = rand_table(rng)
+    for _ in range(20):
+        n = rng.integers(1, 8)
+        ids = rng.integers(0, 20, size=n)
+        vals = rng.normal(size=n)
+        fast = oracle.fm_score(table, ids, vals, order=2)
+        slow = brute_force_fm(table, ids, vals, 2)
+        assert fast == pytest.approx(slow, rel=1e-9, abs=1e-9)
+
+
+def test_order2_with_repeated_ids(rng):
+    # repeated feature ids are legal; identity must still hold
+    table = rand_table(rng)
+    ids, vals = [3, 3, 5], [1.0, 2.0, 0.5]
+    assert oracle.fm_score(table, ids, vals) == pytest.approx(
+        brute_force_fm(table, ids, vals, 2), rel=1e-9)
+
+
+@pytest.mark.parametrize("order", [2, 3, 4])
+def test_anova_vs_brute_force(rng, order):
+    table = rand_table(rng)
+    for _ in range(10):
+        n = int(rng.integers(1, 7))
+        ids = rng.integers(0, 20, size=n)
+        vals = rng.normal(size=n)
+        fast = oracle.fm_score(table, ids, vals, order=order)
+        slow = brute_force_fm(table, ids, vals, order)
+        assert fast == pytest.approx(slow, rel=1e-8, abs=1e-8)
+
+
+def test_ffm_brute_force(rng):
+    field_num, k, v = 3, 2, 10
+    table = rng.normal(size=(v, field_num * k + 1)).astype(np.float64)
+    ids, fields, vals = [1, 4, 7], [0, 2, 1], [0.5, 1.0, 2.0]
+    got = oracle.ffm_score(table, field_num, ids, fields, vals)
+    # manual: linear + pairwise with field-selected vectors
+    want = sum(table[i, -1] * x for i, x in zip(ids, vals))
+    for a, b in itertools.combinations(range(3), 2):
+        va = table[ids[a], :field_num * k].reshape(field_num, k)[fields[b]]
+        vb = table[ids[b], :field_num * k].reshape(field_num, k)[fields[a]]
+        want += float(va @ vb) * vals[a] * vals[b]
+    assert got == pytest.approx(want, rel=1e-12)
+
+
+def test_regularization_unique_rows(rng):
+    table = rand_table(rng)
+    batch = [([1, 2, 2], [1.0, 1.0, 1.0]), ([2, 3], [1.0, 1.0])]
+    k = table.shape[1] - 1
+    reg = oracle.regularization(table, batch, 0.5, 0.25)
+    rows = table[[1, 2, 3]]
+    want = 0.5 * np.sum(rows[:, :k] ** 2) + 0.25 * np.sum(rows[:, k] ** 2)
+    assert reg == pytest.approx(want, rel=1e-12)
+
+
+def test_logistic_loss_matches_naive():
+    scores = np.array([0.0, 2.0, -3.0])
+    labels = np.array([1.0, 0.0, 1.0])
+    naive = np.mean([np.log(1 + np.exp(-s)) if y == 1 else
+                     np.log(1 + np.exp(s))
+                     for s, y in zip(scores, labels)])
+    assert oracle.logistic_loss(scores, labels) == pytest.approx(
+        float(naive), rel=1e-9)
+
+
+def test_grad_fd_sanity(rng):
+    # finite-diff grad of the linear weight of a single-feature example
+    # has a closed form: dL/dw = sigmoid(s) - y times x (mean over batch=1)
+    table = np.zeros((5, 3))
+    table[2] = [0.0, 0.0, 0.5]        # w=0.5, v=0
+    batch = [([2], [2.0])]
+    labels = np.array([1.0])
+    g = oracle.grad_fd(table, batch, labels)
+    s = 1.0  # w*x = 0.5*2
+    sig = 1 / (1 + np.exp(-s))
+    assert g[2, 2] == pytest.approx((sig - 1.0) * 2.0, rel=1e-4)
+    assert np.all(g[[0, 1, 3, 4]] == 0)

@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.data.hashing import hash_feature
+from fast_tffm_tpu.data.parser import ParseError, parse_lines
+
+
+def test_basic_fm():
+    block = parse_lines(["1 3:0.5 7:2.0 1", "0 2", "1 9:1.5"], 100)
+    np.testing.assert_array_equal(block.labels, [1, 0, 1])
+    np.testing.assert_array_equal(block.poses, [0, 3, 4, 5])
+    np.testing.assert_array_equal(block.ids, [3, 7, 1, 2, 9])
+    np.testing.assert_allclose(block.vals, [0.5, 2.0, 1.0, 1.0, 1.5])
+    assert block.fields is None
+    np.testing.assert_array_equal(block.sizes, [3, 1, 1])
+
+
+def test_default_val_is_one():
+    block = parse_lines(["1 5"], 10)
+    np.testing.assert_allclose(block.vals, [1.0])
+
+
+def test_blank_lines_skipped():
+    block = parse_lines(["", "1 2", "   ", "0 3"], 10)
+    assert block.batch_size == 2
+
+
+def test_hashing_mode():
+    block = parse_lines(["1 user_a:2.0 item_b"], 1000, hash_feature_id=True)
+    assert block.ids[0] == hash_feature("user_a", 1000)
+    assert block.ids[1] == hash_feature("item_b", 1000)
+    np.testing.assert_allclose(block.vals, [2.0, 1.0])
+
+
+def test_hashing_mode_accepts_ints_as_strings():
+    a = parse_lines(["1 123"], 1000, hash_feature_id=True)
+    assert a.ids[0] == hash_feature("123", 1000)
+
+
+def test_ffm_format():
+    block = parse_lines(["1 0:3:0.5 2:7", "0 1:2:1.5"], 100,
+                        field_aware=True, field_num=3)
+    np.testing.assert_array_equal(block.fields, [0, 2, 1])
+    np.testing.assert_array_equal(block.ids, [3, 7, 2])
+    np.testing.assert_allclose(block.vals, [0.5, 1.0, 1.5])
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse_lines(["x 1:2"], 10)                       # bad label
+    with pytest.raises(ParseError):
+        parse_lines(["1 a:2"], 10)                       # string id, no hash
+    with pytest.raises(ParseError):
+        parse_lines(["1 50"], 10)                        # id out of range
+    with pytest.raises(ParseError):
+        parse_lines(["1 1:2:3"], 10)                     # 3 parts, not ffm
+    with pytest.raises(ParseError):
+        parse_lines(["1 9:1:0.5"], 10, field_aware=True, field_num=3)
+    with pytest.raises(ParseError):
+        parse_lines(["1 1:xyz"], 10)                     # bad value
+
+
+def test_truncation():
+    line = "1 " + " ".join(f"{i}:1" for i in range(50))
+    block = parse_lines([line], 100, max_features_per_example=8)
+    assert block.sizes[0] == 8
+    np.testing.assert_array_equal(block.ids, np.arange(8))
+
+
+def test_negative_and_float_labels():
+    block = parse_lines(["-1 2", "0.5 3"], 10)
+    np.testing.assert_allclose(block.labels, [-1.0, 0.5])

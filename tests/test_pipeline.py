@@ -1,0 +1,112 @@
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.parser import parse_lines
+from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
+                                         make_device_batch)
+
+CFG = FmConfig(vocabulary_size=1000, factor_num=4, batch_size=4,
+               bucket_ladder=(4, 8, 16), shuffle=False)
+
+
+def test_padding_invariants():
+    block = parse_lines(["1 3:0.5 7:2 9", "0 3:1.0"], 1000)
+    b = make_device_batch(block, CFG)
+    B, L = b.local_idx.shape
+    assert B == 4 and L == 4                      # bucket of max nnz 3 -> 4
+    # last uniq slot is always padding
+    assert b.uniq_ids[-1] == CFG.pad_id
+    # real uniques present, sorted, no dupes
+    assert set(b.uniq_ids.tolist()) == {3, 7, 9, CFG.pad_id}
+    # local_idx resolves back to global ids; padding points at pad slot
+    resolved = b.uniq_ids[b.local_idx]
+    assert resolved[0, 0] == 3 and resolved[0, 1] == 7 and resolved[0, 2] == 9
+    assert resolved[0, 3] == CFG.pad_id
+    assert (resolved[2:] == CFG.pad_id).all()     # dummy examples
+    # padded vals are zero; dummy examples have weight 0
+    assert b.vals[0, 3] == 0.0
+    np.testing.assert_array_equal(b.weights, [1, 1, 0, 0])
+    assert b.num_real == 2
+
+
+def test_uniq_dedup_across_examples():
+    block = parse_lines(["1 5 6", "0 5 6", "1 5"], 1000)
+    b = make_device_batch(block, CFG)
+    real = b.uniq_ids[b.uniq_ids != CFG.pad_id]
+    assert sorted(real.tolist()) == [5, 6]
+
+
+def test_batch_iterator_epochs_and_order(tmp_path):
+    p = tmp_path / "d.txt"
+    p.write_text("".join(f"{i % 2} {i}:1\n" for i in range(10)))
+    cfg = FmConfig(vocabulary_size=100, batch_size=4, shuffle=False,
+                   bucket_ladder=(4,))
+    batches = list(batch_iterator(cfg, [str(p)], training=True, epochs=2))
+    # 10 examples -> 3 batches/epoch (4+4+2), 2 epochs
+    assert len(batches) == 6
+    assert [b.num_real for b in batches] == [4, 4, 2, 4, 4, 2]
+    # order preserved without shuffle
+    ids0 = batches[0].uniq_ids[batches[0].local_idx[:, 0]]
+    np.testing.assert_array_equal(ids0, [0, 1, 2, 3])
+
+
+def test_shuffle_deterministic_and_complete(tmp_path):
+    p = tmp_path / "d.txt"
+    n = 57
+    p.write_text("".join(f"1 {i}:1\n" for i in range(n)))
+    cfg = FmConfig(vocabulary_size=100, batch_size=8, shuffle=True,
+                   queue_size=16, seed=42, bucket_ladder=(4,))
+
+    def collect():
+        seen = []
+        for b in batch_iterator(cfg, [str(p)], training=True, epochs=1):
+            ids = b.uniq_ids[b.local_idx[:b.num_real, 0]]
+            seen.extend(ids.tolist())
+        return seen
+
+    a, b = collect(), collect()
+    assert a == b                                  # deterministic
+    assert sorted(a) == list(range(n))             # complete, no dupes
+    assert a != list(range(n))                     # actually shuffled
+
+
+def test_sharding_disjoint_complete(tmp_path):
+    p = tmp_path / "d.txt"
+    n = 37
+    p.write_text("".join(f"1 {i}:1\n" for i in range(n)))
+    cfg = FmConfig(vocabulary_size=100, batch_size=4, shuffle=False,
+                   bucket_ladder=(4,))
+    all_seen = []
+    for shard in range(3):
+        for b in batch_iterator(cfg, [str(p)], training=True, epochs=1,
+                                shard_index=shard, num_shards=3):
+            all_seen.extend(
+                b.uniq_ids[b.local_idx[:b.num_real, 0]].tolist())
+    assert sorted(all_seen) == list(range(n))
+
+
+def test_weight_files(tmp_path):
+    d = tmp_path / "d.txt"
+    w = tmp_path / "w.txt"
+    d.write_text("1 1:1\n0 2:1\n")
+    w.write_text("0.5\n2.0\n")
+    cfg = FmConfig(vocabulary_size=10, batch_size=2, shuffle=False,
+                   bucket_ladder=(4,))
+    (b,) = list(batch_iterator(cfg, [str(d)], training=True,
+                               weight_files=[str(w)], epochs=1))
+    np.testing.assert_allclose(b.weights, [0.5, 2.0])
+
+
+def test_expand_files(tmp_path):
+    for name in ("a1.txt", "a2.txt"):
+        (tmp_path / name).write_text("x")
+    got = expand_files([str(tmp_path / "a*.txt"), "no_such_literal.txt"])
+    assert got == [str(tmp_path / "a1.txt"), str(tmp_path / "a2.txt"),
+                   "no_such_literal.txt"]
+
+
+def test_oversize_block_rejected():
+    block = parse_lines(["1 1", "1 2", "1 3", "1 4", "1 5"], 10)
+    with pytest.raises(ValueError):
+        make_device_batch(block, CFG)  # 5 examples > batch_size 4

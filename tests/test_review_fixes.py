@@ -1,0 +1,72 @@
+"""Regression tests for review findings: bucket-ladder overflow, blank-
+line alignment in predict, kernel validation, zero-step train runs."""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.parser import parse_lines
+from fast_tffm_tpu.data.pipeline import make_device_batch
+
+
+def test_example_longer_than_ladder_gets_pow2_bucket():
+    cfg = FmConfig(vocabulary_size=5000, batch_size=2,
+                   bucket_ladder=(4, 8), max_features_per_example=0)
+    line = "1 " + " ".join(f"{i}:1" for i in range(300))
+    block = parse_lines([line], 5000)
+    b = make_device_batch(block, cfg)
+    assert b.local_idx.shape[1] == 512        # next pow2 above 300
+    assert b.num_real == 1
+
+
+def test_keep_empty_preserves_line_alignment():
+    lines = ["1 3:1", "", "0 4:1", "   "]
+    block = parse_lines(lines, 10, keep_empty=True)
+    assert block.batch_size == 4
+    np.testing.assert_array_equal(block.sizes, [1, 0, 1, 0])
+    # without keep_empty blanks are dropped (training path)
+    assert parse_lines(lines, 10).batch_size == 2
+
+
+def test_predict_blank_line_scores(tmp_path, rng):
+    import run_tffm
+    train = tmp_path / "train.txt"
+    train.write_text("".join(
+        f"{i % 2} {1 if i % 2 else 2}:1\n" for i in range(64)))
+    pred = tmp_path / "pred.txt"
+    pred.write_text("1 1:1\n\n0 2:1\n")
+    cfg = tmp_path / "c.cfg"
+    cfg.write_text(textwrap.dedent(f"""
+        [General]
+        vocabulary_size = 10
+        factor_num = 2
+        model_file = {tmp_path}/m/fm
+        [Train]
+        train_files = {train}
+        epoch_num = 2
+        batch_size = 16
+        learning_rate = 0.1
+        [Predict]
+        predict_files = {pred}
+        score_path = {tmp_path}/score
+    """))
+    assert run_tffm.main(["train", str(cfg)]) == 0
+    assert run_tffm.main(["predict", str(cfg)]) == 0
+    scores = (tmp_path / "score" / "pred.txt.score").read_text().splitlines()
+    assert len(scores) == 3                   # one per input line, blank too
+    assert float(scores[1]) == pytest.approx(0.5)  # empty example -> sigmoid(0)
+
+
+def test_kernel_validated():
+    with pytest.raises(ValueError):
+        FmConfig(kernel="cuda")
+
+
+def test_multiworker_refused():
+    from fast_tffm_tpu.parallel.distributed import init_from_cluster
+    cfg = FmConfig(worker_hosts=("a:1", "b:2"))
+    with pytest.raises(NotImplementedError):
+        init_from_cluster(cfg, "worker", 1)
+    assert init_from_cluster(FmConfig(), "worker", 0) == (0, 1)

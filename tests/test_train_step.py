@@ -1,0 +1,125 @@
+"""The jitted train step vs the oracle: gradients (finite differences) and
+a full Adagrad update on touched rows; loss decreases on a learnable toy
+problem."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import make_device_batch
+from fast_tffm_tpu.data.parser import parse_lines
+from fast_tffm_tpu.models import oracle
+from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
+                                     init_table, make_train_step)
+
+V, K = 30, 3
+CFG = FmConfig(vocabulary_size=V, factor_num=K, batch_size=4,
+               bucket_ladder=(4, 8), learning_rate=0.1,
+               factor_lambda=0.01, bias_lambda=0.02, adagrad_init=0.1)
+
+
+def toy_batch():
+    lines = ["1 3:0.5 7:1.0 9:2.0", "0 3:1.0 12:0.5", "1 20:1.0",
+             "0 7:0.25 20:1.0"]
+    block = parse_lines(lines, V)
+    batch = [([3, 7, 9], [0.5, 1.0, 2.0]), ([3, 12], [1.0, 0.5]),
+             ([20], [1.0]), ([7, 20], [0.25, 1.0])]
+    labels = np.array([1.0, 0.0, 1.0, 0.0])
+    return make_device_batch(block, CFG), batch, labels
+
+
+def scatter_dense(uniq_ids, grad_rows, num_rows):
+    g = np.zeros((num_rows, grad_rows.shape[1]), dtype=np.float64)
+    for u, row in zip(uniq_ids, grad_rows):
+        if u < V:
+            g[u] += row
+    return g
+
+
+def test_step_matches_oracle_adagrad():
+    spec = ModelSpec.from_config(CFG)
+    table0 = np.asarray(init_table(CFG, seed=1))
+    acc0 = np.asarray(init_accumulator(CFG))
+    b, batch, labels = toy_batch()
+
+    step = make_train_step(spec)
+    t1, a1, loss, scores = step(jax.numpy.asarray(table0),
+                                jax.numpy.asarray(acc0), **batch_args(b))
+    t1, a1 = np.asarray(t1), np.asarray(a1)
+
+    # oracle: dense FD grad -> dense adagrad
+    g = oracle.grad_fd(table0[:-1].astype(np.float64), batch, labels,
+                       factor_lambda=CFG.factor_lambda,
+                       bias_lambda=CFG.bias_lambda)
+    want_t, want_a = oracle.adagrad_step(
+        table0[:-1].astype(np.float64), acc0[:-1].astype(np.float64), g,
+        CFG.learning_rate)
+
+    np.testing.assert_allclose(t1[:-1], want_t, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(a1[:-1], want_a, rtol=2e-3, atol=2e-4)
+    # the dead padding row never moves
+    np.testing.assert_array_equal(t1[-1], 0.0)
+    np.testing.assert_allclose(a1[-1], CFG.adagrad_init)
+
+    # loss value matches oracle
+    s = oracle.batch_scores(table0[:-1].astype(np.float64), batch)
+    want_loss = (oracle.logistic_loss(s, labels)
+                 + oracle.regularization(table0[:-1].astype(np.float64),
+                                         batch, CFG.factor_lambda,
+                                         CFG.bias_lambda))
+    assert float(loss) == pytest.approx(want_loss, rel=1e-4)
+
+
+def test_untouched_rows_unchanged():
+    spec = ModelSpec.from_config(CFG)
+    table0 = np.asarray(init_table(CFG, seed=1))
+    acc0 = np.asarray(init_accumulator(CFG))
+    b, batch, _ = toy_batch()
+    step = make_train_step(spec)
+    t1, a1, _, _ = step(jax.numpy.asarray(table0), jax.numpy.asarray(acc0),
+                        **batch_args(b))
+    touched = {3, 7, 9, 12, 20}
+    untouched = [i for i in range(V) if i not in touched]
+    np.testing.assert_array_equal(np.asarray(t1)[untouched],
+                                  table0[untouched])
+    np.testing.assert_array_equal(np.asarray(a1)[untouched],
+                                  acc0[untouched])
+
+
+def test_zero_weight_examples_do_not_train():
+    spec = ModelSpec.from_config(CFG)
+    table0 = init_table(CFG, seed=2)
+    acc0 = init_accumulator(CFG)
+    # batch of 1 real + 3 dummies: only ids {5} may change
+    block = parse_lines(["1 5:1.0"], V)
+    b = make_device_batch(block, CFG)
+    step = make_train_step(spec)
+    t1, _, _, _ = step(table0, acc0, **batch_args(b))
+    t0, t1 = np.asarray(init_table(CFG, seed=2)), np.asarray(t1)
+    changed = np.where(np.any(t0 != t1, axis=1))[0]
+    assert changed.tolist() == [5]
+
+
+def test_loss_decreases_on_toy_problem():
+    rng = np.random.default_rng(0)
+    spec = ModelSpec.from_config(CFG)
+    table = init_table(CFG, seed=3)
+    acc = init_accumulator(CFG)
+    step = make_train_step(spec)
+    # learnable rule: label = 1 iff feature 1 present (else feature 2)
+    lines = []
+    for _ in range(64):
+        y = int(rng.integers(0, 2))
+        fid = 1 if y else 2
+        extra = int(rng.integers(10, 20))
+        lines.append(f"{y} {fid}:1 {extra}:1")
+    losses = []
+    for epoch in range(15):
+        for i in range(0, 64, 4):
+            block = parse_lines(lines[i:i + 4], V)
+            b = make_device_batch(block, CFG)
+            table, acc, loss, _ = step(table, acc, **batch_args(b))
+            losses.append(float(loss))
+    assert np.mean(losses[-16:]) < 0.55 * np.mean(losses[:16])
