@@ -1,0 +1,294 @@
+// C++ libsvm line parser — the throughput path of the fm_parser contract.
+//
+// The reference implements batch text->CSR parsing as a multithreaded C++
+// TensorFlow custom op (upstream cc/fm_parser.cc; SURVEY.md §2). This is
+// the same job as a dependency-free shared object driven through ctypes
+// (fast_tffm_tpu/data/cparser.py): a newline-separated blob of
+//     <label> <fid>[:<fval>] ...
+// lines in, CSR arrays out. Semantics must match the Python parser
+// (fast_tffm_tpu/data/parser.py) bit-for-bit — including MurmurHash64A
+// feature hashing — and golden tests (tests/test_cparser.py) enforce it.
+//
+// Parallelism: lines are sliced into contiguous ranges, one thread per
+// range parsing into private buffers, stitched in order afterwards, so
+// output ordering is identical to single-threaded parsing.
+
+#include <cctype>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// MurmurHash64A (Austin Appleby, public domain), seed 0 — must match
+// fast_tffm_tpu/data/hashing.py (golden tests pin both).
+uint64_t murmur64(const char* key, size_t len, uint64_t seed) {
+  const uint64_t m = 0xc6a4a7935bd1e995ULL;
+  const int r = 47;
+  uint64_t h = seed ^ (len * m);
+  const unsigned char* data = reinterpret_cast<const unsigned char*>(key);
+  const size_t nblocks = len / 8;
+  for (size_t i = 0; i < nblocks; i++) {
+    uint64_t k;
+    std::memcpy(&k, data + i * 8, 8);
+    k *= m;
+    k ^= k >> r;
+    k *= m;
+    h ^= k;
+    h *= m;
+  }
+  const unsigned char* tail = data + nblocks * 8;
+  uint64_t t = 0;
+  switch (len & 7) {
+    case 7: t ^= uint64_t(tail[6]) << 48; [[fallthrough]];
+    case 6: t ^= uint64_t(tail[5]) << 40; [[fallthrough]];
+    case 5: t ^= uint64_t(tail[4]) << 32; [[fallthrough]];
+    case 4: t ^= uint64_t(tail[3]) << 24; [[fallthrough]];
+    case 3: t ^= uint64_t(tail[2]) << 16; [[fallthrough]];
+    case 2: t ^= uint64_t(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      t ^= uint64_t(tail[0]);
+      h ^= t;
+      h *= m;
+  }
+  h ^= h >> r;
+  h *= m;
+  h ^= h >> r;
+  return h;
+}
+
+struct ShardOut {
+  std::vector<float> labels;
+  std::vector<int32_t> sizes;  // per-example nnz
+  std::vector<int32_t> ids;
+  std::vector<float> vals;
+  bool failed = false;
+  std::string error;
+};
+
+inline bool is_ws(char c) {
+  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+}
+
+// Parse one whitespace-delimited token as float; matches Python float()
+// on normal numeric data. Returns false on garbage/empty.
+inline bool parse_float(const char* begin, const char* end, float* out) {
+  if (begin == end) return false;
+  // strtof needs NUL-terminated input; tokens are short, copy to stack.
+  char buf[64];
+  size_t n = size_t(end - begin);
+  if (n >= sizeof(buf)) return false;
+  std::memcpy(buf, begin, n);
+  buf[n] = '\0';
+  char* endp = nullptr;
+  errno = 0;
+  float v = std::strtof(buf, &endp);
+  if (endp != buf + n || errno == ERANGE) return false;
+  *out = v;
+  return true;
+}
+
+inline bool parse_int(const char* begin, const char* end, int64_t* out) {
+  if (begin == end) return false;
+  char buf[32];
+  size_t n = size_t(end - begin);
+  if (n >= sizeof(buf)) return false;
+  std::memcpy(buf, begin, n);
+  buf[n] = '\0';
+  char* endp = nullptr;
+  errno = 0;
+  long long v = std::strtoll(buf, &endp, 10);
+  if (endp != buf + n || errno == ERANGE) return false;
+  *out = v;
+  return true;
+}
+
+void fail(ShardOut* out, int64_t lineno, const std::string& msg) {
+  out->failed = true;
+  out->error = "line " + std::to_string(lineno) + ": " + msg;
+}
+
+// Parse lines [begin, end) of the blob (byte offsets of line starts are
+// implicit: we scan). `first_lineno` is for error messages only.
+void parse_range(const char* blob, const char* end, int64_t first_lineno,
+                 int64_t vocab, bool hash_ids, int max_feats,
+                 ShardOut* out) {
+  const char* p = blob;
+  int64_t lineno = first_lineno;
+  while (p < end) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', size_t(end - p)));
+    if (line_end == nullptr) line_end = end;
+    const char* q = p;
+    // skip leading whitespace; blank lines are dropped (training path;
+    // keep_empty goes through the Python parser)
+    while (q < line_end && is_ws(*q)) q++;
+    if (q == line_end) {
+      p = line_end + 1;
+      lineno++;
+      continue;
+    }
+    // label token
+    const char* tok_end = q;
+    while (tok_end < line_end && !is_ws(*tok_end)) tok_end++;
+    float label;
+    if (!parse_float(q, tok_end, &label)) {
+      return fail(out, lineno,
+                  "bad label '" + std::string(q, tok_end) + "'");
+    }
+    out->labels.push_back(label);
+    int32_t n_feats = 0;
+    q = tok_end;
+    while (true) {
+      while (q < line_end && is_ws(*q)) q++;
+      if (q >= line_end) break;
+      tok_end = q;
+      const char* colon = nullptr;
+      bool extra_colon = false;
+      while (tok_end < line_end && !is_ws(*tok_end)) {
+        if (*tok_end == ':') {
+          if (colon != nullptr) extra_colon = true;
+          else colon = tok_end;
+        }
+        tok_end++;
+      }
+      if (max_feats > 0 && n_feats >= max_feats) {
+        // Python breaks out at the cap without validating the tail of
+        // the line; skipping (not erroring) matches that.
+        q = tok_end;
+        continue;
+      }
+      if (extra_colon) {
+        return fail(out, lineno,
+                    "bad token '" + std::string(q, tok_end) +
+                        "' (want fid[:val])");
+      }
+      const char* fid_end = colon ? colon : tok_end;
+      int32_t row;
+      if (hash_ids) {
+        row = int32_t(murmur64(q, size_t(fid_end - q), 0) %
+                      uint64_t(vocab));
+      } else {
+        int64_t fid;
+        if (!parse_int(q, fid_end, &fid)) {
+          return fail(out, lineno,
+                      "non-integer feature id '" +
+                          std::string(q, fid_end) +
+                          "' (set hash_feature_id = True for string ids)");
+        }
+        if (fid < 0 || fid >= vocab) {
+          return fail(out, lineno,
+                      "feature id " + std::to_string(fid) +
+                          " out of range [0, " + std::to_string(vocab) +
+                          ")");
+        }
+        row = int32_t(fid);
+      }
+      float val = 1.0f;
+      if (colon != nullptr &&
+          !parse_float(colon + 1, tok_end, &val)) {
+        return fail(out, lineno,
+                    "bad value '" + std::string(colon + 1, tok_end) + "'");
+      }
+      out->ids.push_back(row);
+      out->vals.push_back(val);
+      n_feats++;
+      q = tok_end;
+    }
+    out->sizes.push_back(n_feats);
+    p = line_end + 1;
+    lineno++;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. Outputs:
+//   labels[n_examples], poses[n_examples+1], ids[nnz], vals[nnz]
+// Caller allocates: labels/poses sized for the line count, ids/vals for
+// the worst-case token count (cparser.py sizes them from the blob).
+int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
+                   int hash_ids, int max_feats, int num_threads,
+                   int64_t* n_examples_out, int64_t* nnz_out,
+                   float* labels_out, int32_t* poses_out, int32_t* ids_out,
+                   float* vals_out, char* err_out, int64_t err_cap) {
+  if (vocab <= 0) {
+    std::snprintf(err_out, size_t(err_cap), "vocabulary_size must be > 0");
+    return 1;
+  }
+  int T = num_threads > 0
+              ? num_threads
+              : int(std::min(8u, std::thread::hardware_concurrency()));
+  if (T < 1) T = 1;
+  if (blob_len < (64 << 10)) T = 1;  // small blocks: threading overhead
+
+  // Slice the blob into T ranges on line boundaries.
+  std::vector<const char*> starts{blob};
+  const char* end = blob + blob_len;
+  for (int t = 1; t < T; t++) {
+    const char* target = blob + blob_len * t / T;
+    if (target <= starts.back()) {
+      continue;
+    }
+    const char* nl = static_cast<const char*>(
+        std::memchr(target, '\n', size_t(end - target)));
+    const char* start = nl ? nl + 1 : end;
+    if (start > starts.back()) starts.push_back(start);
+  }
+  starts.push_back(end);
+  int shards = int(starts.size()) - 1;
+
+  // Line numbers per shard for error messages: count newlines up front.
+  std::vector<int64_t> first_lineno(size_t(shards), 0);
+  for (int s = 1; s < shards; s++) {
+    int64_t count = 0;
+    for (const char* c = starts[s - 1]; c < starts[s]; c++) {
+      if (*c == '\n') count++;
+    }
+    first_lineno[size_t(s)] = first_lineno[size_t(s - 1)] + count;
+  }
+
+  std::vector<ShardOut> outs(static_cast<size_t>(shards));
+  std::vector<std::thread> threads;
+  for (int s = 0; s < shards; s++) {
+    threads.emplace_back(parse_range, starts[size_t(s)],
+                         starts[size_t(s) + 1], first_lineno[size_t(s)],
+                         vocab, hash_ids != 0, max_feats, &outs[size_t(s)]);
+  }
+  for (auto& th : threads) th.join();
+
+  for (const auto& o : outs) {
+    if (o.failed) {
+      std::snprintf(err_out, size_t(err_cap), "%s", o.error.c_str());
+      return 1;
+    }
+  }
+
+  // Stitch in order.
+  int64_t b = 0, z = 0;
+  poses_out[0] = 0;
+  for (const auto& o : outs) {
+    std::memcpy(labels_out + b, o.labels.data(),
+                o.labels.size() * sizeof(float));
+    std::memcpy(ids_out + z, o.ids.data(), o.ids.size() * sizeof(int32_t));
+    std::memcpy(vals_out + z, o.vals.data(), o.vals.size() * sizeof(float));
+    for (size_t e = 0; e < o.sizes.size(); e++) {
+      poses_out[b + int64_t(e) + 1] =
+          poses_out[b + int64_t(e)] + o.sizes[e];
+    }
+    b += int64_t(o.labels.size());
+    z += int64_t(o.ids.size());
+  }
+  *n_examples_out = b;
+  *nnz_out = z;
+  return 0;
+}
+
+}  // extern "C"

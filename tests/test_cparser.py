@@ -1,0 +1,93 @@
+"""C++ parser vs Python parser: bit-identical outputs on the same input
+(the golden-parity contract both docstrings promise)."""
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.data import cparser
+from fast_tffm_tpu.data.parser import ParseError, parse_lines
+
+pytestmark = pytest.mark.skipif(not cparser.available(),
+                                reason="C++ parser failed to build")
+
+
+def assert_parity(lines, vocab, **kw):
+    py = parse_lines(lines, vocab, **kw)
+    cc = cparser.parse_lines_fast(lines, vocab, **kw)
+    np.testing.assert_array_equal(cc.labels, py.labels)
+    np.testing.assert_array_equal(cc.poses, py.poses)
+    np.testing.assert_array_equal(cc.ids, py.ids)
+    np.testing.assert_array_equal(cc.vals, py.vals)
+
+
+def test_basic_parity():
+    assert_parity(["1 3:0.5 7:2.0 1", "0 2", "1 9:1.5"], 100)
+
+
+def test_default_val_and_blank_lines():
+    assert_parity(["1 5", "", "0 6:2", "   ", "1 7"], 10)
+
+
+def test_hash_parity():
+    lines = ["1 user_a:2.0 item_b click:0.5", "0 user_c", "1 123 456:7.5"]
+    assert_parity(lines, 999983, hash_feature_id=True)
+
+
+def test_float_formats():
+    assert_parity(["1 1:0.5 2:-1.5 3:1e-3 4:2E2 5:.5 6:5."], 10)
+
+
+def test_labels():
+    assert_parity(["-1 2", "0.5 3", "1e0 4"], 10)
+
+
+def test_truncation_parity():
+    line = "1 " + " ".join(f"{i}:1" for i in range(50))
+    assert_parity([line], 100, max_features_per_example=8)
+    # tokens after the cap are not validated (Python breaks out)
+    assert_parity(["1 1:1 2:2 3:3:3:3"], 100, max_features_per_example=2)
+
+
+def test_error_parity():
+    for bad in (["x 1:2"], ["1 a:2"], ["1 50"], ["1 1:2:3"], ["1 1:xyz"],
+                ["1 -3:1"]):
+        with pytest.raises(ParseError):
+            parse_lines(bad, 10)
+        with pytest.raises(ParseError):
+            cparser.parse_lines_fast(bad, 10)
+
+
+def test_random_fuzz_parity(rng):
+    vocab = 10000
+    lines = []
+    for _ in range(500):
+        n = int(rng.integers(1, 30))
+        toks = []
+        for _ in range(n):
+            fid = int(rng.integers(0, vocab))
+            if rng.uniform() < 0.5:
+                toks.append(f"{fid}:{rng.normal():.6g}")
+            else:
+                toks.append(str(fid))
+        lines.append(f"{int(rng.integers(0, 2))} " + " ".join(toks))
+    assert_parity(lines, vocab)
+    assert_parity(lines, vocab, hash_feature_id=True)
+
+
+def test_multithreaded_ordering(rng):
+    # enough data to engage multiple threads (>64KB blob)
+    lines = [f"{i % 2} {i % 997}:1 {(i * 7) % 997}:0.5 pad_{i}:2"
+             for i in range(20000)]
+    py = parse_lines(lines, 997, hash_feature_id=True)
+    cc = cparser.parse_lines_fast(lines, 997, hash_feature_id=True,
+                                  num_threads=8)
+    np.testing.assert_array_equal(cc.labels, py.labels)
+    np.testing.assert_array_equal(cc.poses, py.poses)
+    np.testing.assert_array_equal(cc.ids, py.ids)
+    np.testing.assert_array_equal(cc.vals, py.vals)
+
+
+def test_empty_input():
+    cc = cparser.parse_lines_fast([], 10)
+    assert cc.batch_size == 0
+    assert len(cc.ids) == 0
