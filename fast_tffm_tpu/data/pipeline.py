@@ -77,14 +77,17 @@ def _ladder_fit(n: int, ladder: Sequence[int]) -> int:
 
 
 def _uniq_ladder(batch_size: int, max_l: int) -> List[int]:
-    """Power-of-two ladder for the unique-row bucket, capped at B*L + 1
-    (+1 guarantees a padding slot even when every id is distinct)."""
+    """Power-of-two ladder for the unique-row bucket; the top rung is the
+    first power of two > B*L (so a padding slot exists even when every id
+    is distinct). All rungs stay powers of two because mesh-sharded runs
+    split the U axis across devices (parallel/sharded.py) and explicit
+    shardings need divisible dims."""
     cap = batch_size * max_l + 1
     out, b = [], 64
     while b < cap:
         out.append(b)
         b *= 2
-    out.append(cap)
+    out.append(b)
     return out
 
 

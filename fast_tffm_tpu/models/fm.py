@@ -131,6 +131,29 @@ def sparse_adagrad_apply(table: jax.Array, acc: jax.Array,
     return table.at[uniq_ids].add(upd), acc
 
 
+def train_step_body(spec: ModelSpec, table, acc, labels, weights, uniq_ids,
+                    local_idx, vals, fields=None):
+    """One full training step (gather -> loss -> grad -> sparse Adagrad).
+
+    Pure function of arrays; jitted directly by make_train_step and jitted
+    with mesh shardings by parallel/sharded.py — single source of truth for
+    the step semantics either way.
+    """
+    gathered = table[uniq_ids]
+
+    def loss_fn(g):
+        return loss_and_scores(spec, g, labels, weights, uniq_ids,
+                               local_idx, vals, fields)
+
+    (loss, scores), grad = jax.value_and_grad(
+        loss_fn, has_aux=True)(gathered)
+    live = (uniq_ids < spec.vocabulary_size).astype(grad.dtype)[:, None]
+    grad = grad * live
+    table, acc = sparse_adagrad_apply(table, acc, uniq_ids, grad,
+                                      spec.learning_rate)
+    return table, acc, loss, scores
+
+
 @functools.lru_cache(maxsize=None)
 def make_train_step(spec: ModelSpec):
     """Build the jitted train step. Signature:
@@ -138,24 +161,17 @@ def make_train_step(spec: ModelSpec):
       -> (table, acc, loss, scores)
     Buffers are donated; one executable per batch-shape bucket. Cached per
     spec so repeated train()/evaluate() calls reuse compiled code."""
+    return jax.jit(functools.partial(train_step_body, spec),
+                   donate_argnums=(0, 1))
 
-    def step(table, acc, labels, weights, uniq_ids, local_idx, vals,
-             fields=None):
-        gathered = table[uniq_ids]
 
-        def loss_fn(g):
-            return loss_and_scores(spec, g, labels, weights, uniq_ids,
-                                   local_idx, vals, fields)
-
-        (loss, scores), grad = jax.value_and_grad(
-            loss_fn, has_aux=True)(gathered)
-        live = (uniq_ids < spec.vocabulary_size).astype(grad.dtype)[:, None]
-        grad = grad * live
-        table, acc = sparse_adagrad_apply(table, acc, uniq_ids, grad,
-                                          spec.learning_rate)
-        return table, acc, loss, scores
-
-    return jax.jit(step, donate_argnums=(0, 1))
+def score_body(spec: ModelSpec, table, uniq_ids, local_idx, vals,
+               fields=None):
+    """Inference forward (gather -> scorer). Shared by the single-device
+    and mesh-sharded score functions — single source of truth, like
+    train_step_body."""
+    gathered = table[uniq_ids]
+    return _scores(spec, gathered, local_idx, vals, fields)
 
 
 @functools.lru_cache(maxsize=None)
@@ -163,12 +179,7 @@ def make_score_fn(spec: ModelSpec):
     """Jitted inference: (table, uniq_ids, local_idx, vals, fields) ->
     raw scores [B] (the predict driver applies sigmoid for logistic).
     Cached per spec — callers may re-request it per file/epoch."""
-
-    def score(table, uniq_ids, local_idx, vals, fields=None):
-        gathered = table[uniq_ids]
-        return _scores(spec, gathered, local_idx, vals, fields)
-
-    return jax.jit(score)
+    return jax.jit(functools.partial(score_body, spec))
 
 
 def batch_args(batch: DeviceBatch) -> Dict[str, np.ndarray]:

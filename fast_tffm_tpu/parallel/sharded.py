@@ -1,0 +1,186 @@
+"""Mesh-sharded training — the parameter-server replacement (SURVEY.md §7.5).
+
+The reference scales by splitting the embedding table into
+``vocabulary_block_num`` row blocks round-robined across TF1 parameter
+servers, with workers gathering active rows and pushing sparse Adagrad
+updates over gRPC, asynchronously (SURVEY §2 "Distributed backend", §3.2).
+
+The TPU-native design here replaces all of that with SPMD over a
+``jax.sharding.Mesh``:
+
+- axes ``("data", "model")``: the batch is sharded over ``data``
+  (data parallelism); the table and its Adagrad accumulator are
+  **row-sharded over every device** (``P(("data", "model"))``) — the mesh
+  *is* the parameter server, and FSDP-style row sharding means the table's
+  memory scales with the slice, exactly like adding PS tasks.
+- the per-step gather of the batch's unique rows and the scatter-add of
+  their gradients cross shard boundaries; XLA/GSPMD inserts the
+  collectives (all-gather of the small unique-id set, psum of gathered
+  rows, sharded scatter) over ICI — no hand-written transport, per the
+  scaling-book recipe (annotate shardings, let XLA place collectives).
+- updates are **synchronous**: every step sees every gradient. This is a
+  deliberate semantics upgrade over the reference's lock-free async
+  (hogwild) PS updates — a documented divergence (SURVEY §7 hard part #2).
+
+Tensor/pipeline/sequence/expert parallelism are structurally N/A for FMs
+(no big dense ops, 2-layer-deep model, unordered feature bags, no MoE —
+SURVEY §2 parallelism inventory); the two axes that exist for this model
+family, batch-DP and table row sharding (model parallelism for an
+embedding model), are both first-class here.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.models.fm import ModelSpec, score_body, train_step_body
+
+# Table rows are sharded across *all* mesh devices — both axes — so table
+# memory per chip shrinks linearly with slice size (the PS-scaling analogue).
+ROW_SPEC = P(("data", "model"), None)
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              model_axis: int = 1) -> Mesh:
+    """Build a ("data", "model") mesh over ``devices`` (default: all).
+
+    ``model_axis`` splits devices between the two axes; with the default 1
+    the mesh is pure data-parallel (table still row-sharded over all
+    devices). Single device -> trivial 1x1 mesh, same code path.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model_axis <= 0 or n % model_axis:
+        raise ValueError(f"model_axis {model_axis} must divide {n} devices")
+    n_data = n // model_axis
+    # The pipeline's unique-id buckets are powers of two (>= 64), so the
+    # data axis must be a power of two <= 64 for the U axis to shard
+    # evenly; TPU slices are powers of two anyway.
+    if n_data & (n_data - 1) or n_data > 64:
+        raise ValueError(
+            f"data axis size {n_data} must be a power of two <= 64 so the "
+            "pipeline's power-of-two unique-id buckets shard evenly")
+    grid = np.asarray(devices).reshape(n_data, model_axis)
+    return Mesh(grid, ("data", "model"))
+
+
+def _shardings(mesh: Mesh, with_fields: bool):
+    row = NamedSharding(mesh, ROW_SPEC)
+    vec = NamedSharding(mesh, P("data"))          # labels/weights/uniq_ids
+    mat = NamedSharding(mesh, P("data", None))    # local_idx/vals/fields
+    repl = NamedSharding(mesh, P())
+    in_sh = [row, row, vec, vec, vec, mat, mat]
+    if with_fields:
+        in_sh.append(mat)
+    out_sh = (row, row, repl, vec)
+    return tuple(in_sh), out_sh
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_train_step(spec: ModelSpec, mesh: Mesh,
+                            with_fields: Optional[bool] = None):
+    """The same step as models.fm.make_train_step, jitted with mesh
+    shardings so GSPMD partitions it: batch over ``data``, table rows over
+    the whole mesh, loss replicated. Cached per (spec, mesh)."""
+    if with_fields is None:
+        with_fields = spec.model_type == "ffm"
+    in_sh, out_sh = _shardings(mesh, with_fields)
+    fn = functools.partial(train_step_body, spec)
+    jitted = jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh,
+                     donate_argnums=(0, 1))
+
+    # pjit rejects kwargs when in_shardings is set; keep the kwargs-friendly
+    # surface of make_train_step via a thin positional adapter.
+    def step(table, acc, labels, weights, uniq_ids, local_idx, vals,
+             fields=None):
+        args = (table, acc, labels, weights, uniq_ids, local_idx, vals)
+        if with_fields:
+            args += (fields,)
+        return jitted(*args)
+
+    return step
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_score_fn(spec: ModelSpec, mesh: Mesh,
+                          with_fields: Optional[bool] = None):
+    """Sharded inference: row-sharded table in, batch-sharded scores out."""
+    if with_fields is None:
+        with_fields = spec.model_type == "ffm"
+    row = NamedSharding(mesh, ROW_SPEC)
+    vec = NamedSharding(mesh, P("data"))
+    mat = NamedSharding(mesh, P("data", None))
+    in_sh = [row, vec, mat, mat] + ([mat] if with_fields else [])
+
+    jitted = jax.jit(functools.partial(score_body, spec),
+                     in_shardings=tuple(in_sh), out_shardings=vec)
+
+    def score(table, uniq_ids, local_idx, vals, fields=None):
+        args = (table, uniq_ids, local_idx, vals)
+        if with_fields:
+            args += (fields,)
+        return jitted(*args)
+
+    return score
+
+
+def padded_num_rows(cfg: FmConfig, mesh: Mesh) -> int:
+    """Table rows rounded up to a multiple of the mesh device count
+    (explicit shardings need divisible dims). The extra rows sit past
+    ``pad_id`` so no id can ever gather or update them; they are sliced
+    off at checkpoint/export time."""
+    n = int(mesh.devices.size)
+    return -(-cfg.num_rows // n) * n
+
+
+def init_sharded_state(cfg: FmConfig, mesh: Mesh, seed: int = 0
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Initialise (table, accumulator) directly sharded: jit with
+    out_shardings makes every device materialise only its own row shard —
+    a 10^9-row table never exists on one host (SURVEY §7 hard part #3).
+
+    Row values match init_table() exactly for the first ``cfg.num_rows``
+    rows (same key, same distribution; the pad tail is appended, not
+    interleaved), so single-device and sharded runs are comparable.
+    """
+    row = NamedSharding(mesh, ROW_SPEC)
+    n_rows = padded_num_rows(cfg, mesh)
+    shape = (cfg.num_rows, cfg.row_dim)
+
+    def init(key):
+        t = jax.random.uniform(key, shape, dtype=jnp.float32,
+                               minval=-cfg.init_value_range,
+                               maxval=cfg.init_value_range)
+        t = t.at[cfg.num_rows - 1:].set(0.0)
+        pad = jnp.zeros((n_rows - cfg.num_rows, cfg.row_dim), jnp.float32)
+        a = jnp.full((n_rows, cfg.row_dim), cfg.adagrad_init, jnp.float32)
+        return jnp.concatenate([t, pad], axis=0), a
+
+    return jax.jit(init, out_shardings=(row, row))(jax.random.PRNGKey(seed))
+
+
+def shard_batch(mesh: Mesh, **arrays) -> dict:
+    """Place host batch arrays with their mesh shardings (keeps per-step
+    host->device transfers going straight to the right shards)."""
+    vec = NamedSharding(mesh, P("data"))
+    mat = NamedSharding(mesh, P("data", None))
+    n_data = mesh.shape["data"]
+    out = {}
+    for name, arr in arrays.items():
+        if arr is None:
+            continue
+        if np.shape(arr)[0] % n_data:
+            raise ValueError(
+                f"batch array {name!r} dim 0 ({np.shape(arr)[0]}) must be "
+                f"divisible by the mesh data axis ({n_data}); pick a "
+                f"batch_size that is a multiple of it")
+        sh = vec if np.ndim(arr) == 1 else mat
+        out[name] = jax.device_put(arr, sh)
+    return out

@@ -14,8 +14,19 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+import jax  # noqa: E402
+
+# The environment's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon (the tunnelled TPU), so the env vars above are too
+# late for platform selection; jax.config still works, and the CPU client
+# is created lazily so the forced host device count applies.
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+assert jax.device_count() == 8, (
+    f"expected 8 forced CPU devices, got {jax.devices()}")
 
 
 @pytest.fixture

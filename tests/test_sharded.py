@@ -1,0 +1,148 @@
+"""Mesh-sharded train step == single-device step, bit-for-bit-ish.
+
+SURVEY.md §4: the JAX analogue of the reference's localhost-PS smoke test
+is a fake multi-device CPU mesh. These tests run the same batches through
+the unsharded jitted step and the 8-device sharded step (data-parallel,
+row-sharded table) and require matching results — the property the
+reference *cannot* have (its PS updates are async/racy by design).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import batch_iterator
+from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
+                                     init_table, make_score_fn,
+                                     make_train_step)
+from fast_tffm_tpu.parallel.sharded import (init_sharded_state, make_mesh,
+                                            make_sharded_score_fn,
+                                            make_sharded_train_step,
+                                            shard_batch)
+
+
+def _write_data(tmp_path, n=96, seed=3, field_aware=False):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        nnz = rng.integers(1, 12)
+        ids = rng.choice(64, size=nnz, replace=False)
+        parts = ["1" if rng.random() < 0.5 else "0"]
+        for fid in ids:
+            if field_aware:
+                parts.append(f"{rng.integers(0, 4)}:{fid}:{rng.random():.3f}")
+            else:
+                parts.append(f"{fid}:{rng.random():.3f}")
+        lines.append(" ".join(parts))
+    p = tmp_path / "train.txt"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _cfg(path, **kw):
+    base = dict(vocabulary_size=64, factor_num=4, batch_size=16,
+                train_files=(path,), epoch_num=1, shuffle=False,
+                learning_rate=0.1, factor_lambda=1e-4, bias_lambda=1e-4)
+    base.update(kw)
+    return FmConfig(**base)
+
+
+@pytest.mark.parametrize("model_axis", [1, 2])
+def test_sharded_step_matches_single_device(tmp_path, model_axis):
+    path = _write_data(tmp_path)
+    cfg = _cfg(path)
+    spec = ModelSpec.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:8], model_axis=model_axis)
+
+    table_s, acc_s = init_sharded_state(cfg, mesh, seed=0)
+    # Same seed, same init values on the single-device path (sharded table
+    # may carry dead pad rows past num_rows for divisibility).
+    table_1 = init_table(cfg, 0)
+    acc_1 = init_accumulator(cfg)
+    np.testing.assert_allclose(np.asarray(table_s)[:cfg.num_rows],
+                               np.asarray(table_1), rtol=0, atol=0)
+
+    step_1 = make_train_step(spec)
+    step_s = make_sharded_train_step(spec, mesh)
+    for batch in batch_iterator(cfg, cfg.train_files, training=True):
+        args = batch_args(batch)
+        table_1, acc_1, loss_1, scores_1 = step_1(table_1, acc_1, **args)
+        placed = shard_batch(mesh, **args)
+        table_s, acc_s, loss_s, scores_s = step_s(table_s, acc_s, **placed)
+        np.testing.assert_allclose(float(loss_s), float(loss_1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(scores_s),
+                                   np.asarray(scores_1),
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(table_s)[:cfg.num_rows],
+                               np.asarray(table_1), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(acc_s)[:cfg.num_rows],
+                               np.asarray(acc_1), rtol=1e-4, atol=1e-6)
+
+
+def test_sharded_score_matches(tmp_path):
+    path = _write_data(tmp_path, seed=5)
+    cfg = _cfg(path)
+    spec = ModelSpec.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:4])
+    table = init_table(cfg, 1)
+    table_s, _ = init_sharded_state(cfg, mesh, seed=1)  # same values + pad
+    score_1 = make_score_fn(spec)
+    score_s = make_sharded_score_fn(spec, mesh)
+    for batch in batch_iterator(cfg, cfg.train_files, training=False):
+        args = batch_args(batch)
+        args.pop("labels"), args.pop("weights")
+        s1 = np.asarray(score_1(table, **args))
+        ss = np.asarray(score_s(table_s, **shard_batch(mesh, **args)))
+        np.testing.assert_allclose(ss, s1, rtol=1e-4, atol=1e-5)
+
+
+def test_sharded_ffm_step(tmp_path):
+    path = _write_data(tmp_path, seed=7, field_aware=True)
+    cfg = _cfg(path, model_type="ffm", field_num=4)
+    spec = ModelSpec.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:8], model_axis=2)
+    table_1 = init_table(cfg, 0)
+    acc_1 = init_accumulator(cfg)
+    table_s, acc_s = init_sharded_state(cfg, mesh, seed=0)
+    step_1 = make_train_step(spec)
+    step_s = make_sharded_train_step(spec, mesh)
+    for batch in batch_iterator(cfg, cfg.train_files, training=True):
+        args = batch_args(batch)
+        table_1, acc_1, loss_1, _ = step_1(table_1, acc_1, **args)
+        placed = shard_batch(mesh, **args)
+        table_s, acc_s, loss_s, _ = step_s(table_s, acc_s, **placed)
+        np.testing.assert_allclose(float(loss_s), float(loss_1),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(table_s)[:cfg.num_rows],
+                               np.asarray(table_1), rtol=1e-4, atol=1e-6)
+
+
+def test_ladder_overflow_stays_power_of_two(tmp_path):
+    """The uniq ladder's top rung must stay a power of two so the U axis
+    always divides the data axis even when every id is distinct."""
+    path = _write_data(tmp_path, n=16, seed=9)
+    cfg = _cfg(path, batch_size=16, max_features_per_example=8,
+               bucket_ladder=(8,))
+    spec = ModelSpec.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:8])
+    table_s, acc_s = init_sharded_state(cfg, mesh)
+    step_s = make_sharded_train_step(spec, mesh)
+    loss = None
+    for batch in batch_iterator(cfg, cfg.train_files, training=True):
+        assert len(batch.uniq_ids) % 8 == 0
+        args = batch_args(batch)
+        table_s, acc_s, loss, _ = step_s(table_s, acc_s,
+                                         **shard_batch(mesh, **args))
+    assert np.isfinite(float(loss))
+
+
+def test_shard_batch_rejects_indivisible_batch(tmp_path):
+    path = _write_data(tmp_path, n=10, seed=11)
+    cfg = _cfg(path, batch_size=10)
+    mesh = make_mesh(jax.devices()[:8])
+    for batch in batch_iterator(cfg, cfg.train_files, training=True):
+        with pytest.raises(ValueError, match="divisible"):
+            shard_batch(mesh, **batch_args(batch))
+        break
