@@ -1,0 +1,102 @@
+"""Benchmark: end-to-end training throughput on the flagship FM config.
+
+Mirrors BASELINE config #1 shapes (2nd-order FM, k=8, Criteo-Kaggle-like
+data: ~39 features/example, 1M-row hash space) on whatever single device
+is present (the driver runs this on one real TPU chip).
+
+Measures the full training loop — host text parsing (C++ parser), batch
+building/dedup, host->device transfer, and the jitted train step — i.e.
+the same end-to-end examples/sec the reference's `sess.run` loop measures.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+vs_baseline: BASELINE.json publishes no reference numbers ("published":
+{}); the only stated target is the north star of 1e9 examples/hour on a
+v5e-64 slice == 1e9/3600/64 ~= 4340 examples/sec/chip. vs_baseline is
+value / 4340 — i.e. >= 1.0 means this single chip sustains its share of
+the north-star rate.
+"""
+
+import json
+import time
+
+import numpy as np
+
+NORTH_STAR_PER_CHIP = 1e9 / 3600.0 / 64.0  # examples/sec/chip
+
+
+def synth_lines(n, vocab, seed=0):
+    """Criteo-like libsvm lines: 39 features (13 numeric-ish ids with
+    values + 26 one-hot categorical ids), ids spread over the hash space."""
+    rng = np.random.default_rng(seed)
+    labels = (rng.random(n) < 0.25).astype(np.int32)
+    num_ids = rng.integers(0, 13, size=(n, 13)) * 997 % vocab
+    num_vals = np.round(rng.gamma(1.0, 2.0, size=(n, 13)), 2)
+    cat_ids = rng.integers(0, vocab, size=(n, 26))
+    lines = []
+    for i in range(n):
+        parts = [str(labels[i])]
+        parts += [f"{num_ids[i, j]}:{num_vals[i, j]}" for j in range(13)]
+        parts += [f"{cat_ids[i, j]}:1" for j in range(26)]
+        lines.append(" ".join(parts))
+    return lines
+
+
+def main():
+    import jax
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.data.cparser import parse_lines_fast
+    from fast_tffm_tpu.data.parser import parse_lines
+    from fast_tffm_tpu.data.pipeline import make_device_batch
+    from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
+                                         init_accumulator, init_table,
+                                         make_train_step)
+
+    B = 8192
+    cfg = FmConfig(vocabulary_size=1 << 20, factor_num=8, batch_size=B,
+                   learning_rate=0.05, factor_lambda=1e-6, bias_lambda=1e-6,
+                   max_features_per_example=64, bucket_ladder=(64,))
+    spec = ModelSpec.from_config(cfg)
+
+    n_warm, n_timed = 4, 40
+    n_batches = 8  # distinct host batches, cycled (keeps host RAM modest)
+    lines = synth_lines(n_batches * B, cfg.vocabulary_size)
+    try:
+        blocks = [parse_lines_fast(lines[i * B:(i + 1) * B],
+                                   cfg.vocabulary_size,
+                                   max_features_per_example=64)
+                  for i in range(n_batches)]
+    except (OSError, RuntimeError):
+        blocks = [parse_lines(lines[i * B:(i + 1) * B], cfg.vocabulary_size,
+                              max_features_per_example=64)
+                  for i in range(n_batches)]
+
+    table = init_table(cfg, 0)
+    acc = init_accumulator(cfg)
+    step = make_train_step(spec)
+
+    # Warmup: compile + first touches.
+    for i in range(n_warm):
+        b = make_device_batch(blocks[i % n_batches], cfg)
+        table, acc, loss, _ = step(table, acc, **batch_args(b))
+    jax.block_until_ready((table, acc))
+
+    t0 = time.perf_counter()
+    for i in range(n_timed):
+        b = make_device_batch(blocks[i % n_batches], cfg)
+        table, acc, loss, _ = step(table, acc, **batch_args(b))
+    jax.block_until_ready((table, acc))
+    dt = time.perf_counter() - t0
+
+    eps = n_timed * B / dt
+    print(json.dumps({
+        "metric": "train_examples_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(eps / NORTH_STAR_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,9 +59,15 @@ class CheckpointState:
         self._mngr.close()
 
 
-def export_npz(table, path: str) -> None:
-    """Dense export of the parameter table (without the dead padding row)
-    for parity checks / external consumers."""
-    arr = np.asarray(table)[:-1]
+def export_npz(table, path: str,
+               vocabulary_size: Optional[int] = None) -> None:
+    """Dense export of the parameter table for parity checks / external
+    consumers. Pass ``vocabulary_size`` to slice off dead rows exactly:
+    the pad row at index ``vocabulary_size`` plus any divisibility pad
+    rows a mesh-sharded table carries (parallel/sharded.padded_num_rows).
+    Without it, only the single trailing pad row is dropped (valid for
+    unsharded tables only)."""
+    arr = np.asarray(table)
+    arr = arr[:vocabulary_size] if vocabulary_size is not None else arr[:-1]
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
     np.savez_compressed(path, table=arr)

@@ -71,11 +71,17 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(grid, ("data", "model"))
 
 
+def _layout(mesh: Mesh):
+    """The one encoding of the sharding layout: (row, vec, mat, repl) =
+    (table rows, per-example vectors, per-example matrices, replicated)."""
+    return (NamedSharding(mesh, ROW_SPEC),
+            NamedSharding(mesh, P("data")),
+            NamedSharding(mesh, P("data", None)),
+            NamedSharding(mesh, P()))
+
+
 def _shardings(mesh: Mesh, with_fields: bool):
-    row = NamedSharding(mesh, ROW_SPEC)
-    vec = NamedSharding(mesh, P("data"))          # labels/weights/uniq_ids
-    mat = NamedSharding(mesh, P("data", None))    # local_idx/vals/fields
-    repl = NamedSharding(mesh, P())
+    row, vec, mat, repl = _layout(mesh)
     in_sh = [row, row, vec, vec, vec, mat, mat]
     if with_fields:
         in_sh.append(mat)
@@ -114,9 +120,7 @@ def make_sharded_score_fn(spec: ModelSpec, mesh: Mesh,
     """Sharded inference: row-sharded table in, batch-sharded scores out."""
     if with_fields is None:
         with_fields = spec.model_type == "ffm"
-    row = NamedSharding(mesh, ROW_SPEC)
-    vec = NamedSharding(mesh, P("data"))
-    mat = NamedSharding(mesh, P("data", None))
+    row, vec, mat, _ = _layout(mesh)
     in_sh = [row, vec, mat, mat] + ([mat] if with_fields else [])
 
     jitted = jax.jit(functools.partial(score_body, spec),
@@ -134,8 +138,8 @@ def make_sharded_score_fn(spec: ModelSpec, mesh: Mesh,
 def padded_num_rows(cfg: FmConfig, mesh: Mesh) -> int:
     """Table rows rounded up to a multiple of the mesh device count
     (explicit shardings need divisible dims). The extra rows sit past
-    ``pad_id`` so no id can ever gather or update them; they are sliced
-    off at checkpoint/export time."""
+    ``pad_id`` so no id can ever gather or update them; exports slice
+    them off via ``export_npz(..., vocabulary_size=...)``."""
     n = int(mesh.devices.size)
     return -(-cfg.num_rows // n) * n
 
@@ -169,8 +173,7 @@ def init_sharded_state(cfg: FmConfig, mesh: Mesh, seed: int = 0
 def shard_batch(mesh: Mesh, **arrays) -> dict:
     """Place host batch arrays with their mesh shardings (keeps per-step
     host->device transfers going straight to the right shards)."""
-    vec = NamedSharding(mesh, P("data"))
-    mat = NamedSharding(mesh, P("data", None))
+    _, vec, mat, _ = _layout(mesh)
     n_data = mesh.shape["data"]
     out = {}
     for name, arr in arrays.items():

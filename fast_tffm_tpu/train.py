@@ -101,7 +101,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         epoch, auc, n)
     loss_val = float(loss) if loss is not None else loss_val
     ckpt.save(global_step, table, acc, force=True)
-    export_npz(table, cfg.model_file + ".npz")
+    export_npz(table, cfg.model_file + ".npz",
+               vocabulary_size=cfg.vocabulary_size)
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
                 global_step, loss_val, timer.examples_per_sec)
     ckpt.close()

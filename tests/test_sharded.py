@@ -146,3 +146,17 @@ def test_shard_batch_rejects_indivisible_batch(tmp_path):
         with pytest.raises(ValueError, match="divisible"):
             shard_batch(mesh, **batch_args(batch))
         break
+
+
+def test_export_npz_slices_padded_table(tmp_path):
+    from fast_tffm_tpu.checkpoint import export_npz
+    cfg = _cfg(str(tmp_path / "unused.txt"))
+    mesh = make_mesh(jax.devices()[:8])
+    table_s, _ = init_sharded_state(cfg, mesh)
+    assert np.asarray(table_s).shape[0] % 8 == 0  # padded for the mesh
+    out = tmp_path / "table.npz"
+    export_npz(table_s, str(out), vocabulary_size=cfg.vocabulary_size)
+    arr = np.load(out)["table"]
+    assert arr.shape == (cfg.vocabulary_size, cfg.row_dim)
+    np.testing.assert_allclose(
+        arr, np.asarray(table_s)[:cfg.vocabulary_size])
