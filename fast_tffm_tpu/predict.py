@@ -17,7 +17,8 @@ import numpy as np
 
 from fast_tffm_tpu.checkpoint import CheckpointState
 from fast_tffm_tpu.config import FmConfig
-from fast_tffm_tpu.data.pipeline import batch_iterator, expand_files
+from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
+                                         prefetch)
 from fast_tffm_tpu.metrics import sigmoid
 from fast_tffm_tpu.models.fm import ModelSpec, batch_args, make_score_fn
 from fast_tffm_tpu.utils.logging import get_logger
@@ -44,8 +45,8 @@ def predict_scores(cfg: FmConfig, table: jax.Array,
     out: List[np.ndarray] = []
     # keep_empty: blank input lines become zero-feature examples so the
     # score file stays line-aligned with the input (SURVEY §3.4).
-    for batch in batch_iterator(cfg, files, training=False, epochs=1,
-                                keep_empty=True):
+    for batch in prefetch(batch_iterator(cfg, files, training=False,
+                                         epochs=1, keep_empty=True)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         scores = np.asarray(score_fn(table, **args))

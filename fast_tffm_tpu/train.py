@@ -21,7 +21,7 @@ import numpy as np
 
 from fast_tffm_tpu.checkpoint import CheckpointState, export_npz
 from fast_tffm_tpu.config import FmConfig
-from fast_tffm_tpu.data.pipeline import batch_iterator
+from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
 from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_score_fn,
@@ -37,7 +37,8 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     score_fn = make_score_fn(spec)
     auc = StreamingAUC()
     n = 0
-    for batch in batch_iterator(cfg, files, training=False, epochs=1):
+    for batch in prefetch(batch_iterator(cfg, files, training=False,
+                                         epochs=1)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         scores = np.asarray(score_fn(table, **args))
@@ -80,11 +81,10 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     loss = None
     loss_val = float("nan")
     for epoch in range(cfg.epoch_num):
-        for batch in batch_iterator(cfg, cfg.train_files, training=True,
-                                    weight_files=cfg.weight_files,
-                                    shard_index=shard_index,
-                                    num_shards=num_shards, epochs=1,
-                                    seed=cfg.seed + epoch):
+        for batch in prefetch(batch_iterator(
+                cfg, cfg.train_files, training=True,
+                weight_files=cfg.weight_files, shard_index=shard_index,
+                num_shards=num_shards, epochs=1, seed=cfg.seed + epoch)):
             table, acc, loss, _ = step_fn(table, acc, **batch_args(batch))
             global_step += 1
             timer.tick(batch.num_real)

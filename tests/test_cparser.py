@@ -91,3 +91,15 @@ def test_empty_input():
     cc = cparser.parse_lines_fast([], 10)
     assert cc.batch_size == 0
     assert len(cc.ids) == 0
+
+
+def test_zero_padded_ids_parse_like_python():
+    """Leading zeros must not count toward the digit limit (Python int()
+    parity): '000...05' is id 5."""
+    from fast_tffm_tpu.data.cparser import parse_lines_fast
+    from fast_tffm_tpu.data.parser import parse_lines
+    lines = ["1 0000000000000000005:1.5 7:2.0"]
+    a = parse_lines_fast(lines, 100)
+    b = parse_lines(lines, 100)
+    assert a.ids.tolist() == b.ids.tolist() == [5, 7]
+    assert a.vals.tolist() == b.vals.tolist()

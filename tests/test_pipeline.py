@@ -110,3 +110,24 @@ def test_oversize_block_rejected():
     block = parse_lines(["1 1", "1 2", "1 3", "1 4", "1 5"], 10)
     with pytest.raises(ValueError):
         make_device_batch(block, CFG)  # 5 examples > batch_size 4
+
+
+def test_prefetch_worker_exits_on_abandoned_consumer(monkeypatch):
+    """Breaking out of a prefetch loop must not strand the worker thread
+    blocked on a full queue (it holds file handles and batches)."""
+    import os
+    import threading
+    import time
+    from fast_tffm_tpu.data.pipeline import prefetch
+
+    monkeypatch.setattr(os, "sched_getaffinity",
+                        lambda pid: set(range(4)), raising=False)
+    before = threading.active_count()
+    for _ in range(5):
+        gen = prefetch(iter(range(100)), depth=1)
+        assert next(gen) == 0
+        gen.close()  # abandons mid-stream -> stop flag must fire
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 1
