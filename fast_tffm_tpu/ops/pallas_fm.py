@@ -1,0 +1,152 @@
+"""Pallas TPU kernel for the fused FM interaction — the native-op core.
+
+The reference's hot ops are C++ TF kernels: ``fm_scorer`` (forward) and
+``fm_grad`` (backward) over a CSR batch (SURVEY.md §2, Appendix B). The
+TPU-native analogue is this Pallas pair: one fused VMEM pass computes the
+linear + (Σv)²−Σv² interaction per example without materialising any of
+the [B, L, K] intermediates (z, z², their squares) in HBM, and a
+``jax.custom_vjp`` routes autodiff into the matching hand-written
+backward kernel — exactly how the reference hooks ``fm_grad`` in via
+``RegisterGradient`` (SURVEY §2 "Op wrappers").
+
+Layout: the caller gathers rows ``[B, L, K+1]`` (XLA's dynamic gather is
+already optimal for that part) and hands the kernel ``v`` TRANSPOSED to
+``[B, K, L]`` — lanes carry L (a bucket size, typically 64+), sublanes
+carry K. With K minor instead, Mosaic pads K (often 8) up to the 128
+lanes, a 16x VMEM blowup that OOMs scoped vmem at real batch sizes.
+``w [B, L]`` and values ``x [B, L]`` ride along; blocked over B. Padded
+slots carry ``x == 0`` so they contribute exactly zero to every term
+(same invariant as ops/interaction.py).
+
+Backward math (per example, g = dL/dscore):
+    dw[l]    = g * x[l]
+    dv[l, f] = g * x[l] * (s[f] - z[l, f]),   s = Σ_l z,  z = v * x
+    dx[l]    = g * (w[l] + Σ_f v[l, f] * (s[f] - z[l, f]))
+The backward kernel recomputes ``s`` from inputs instead of saving
+residuals — one extra VMEM reduction in exchange for zero HBM residual
+traffic (the rematerialisation trade SURVEY §7 calls for).
+
+Falls back to interpret mode off-TPU so the same code path is testable
+on the CPU mesh (tests/test_pallas_fm.py pins parity vs the XLA path).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_b(B: int, K: int, L: int) -> int:
+    """Largest power-of-two divisor of B keeping one v block (with its
+    lane padding to 128) within a ~2 MB VMEM budget — the kernels hold a
+    handful of block-sized temporaries and Mosaic double-buffers blocks
+    against the 16 MB scoped-vmem limit."""
+    lanes = -(-L // 128) * 128
+    bytes_per_row = max(K, 8) * lanes * 4
+    budget = 2 << 20
+    b = 1
+    while B % (b * 2) == 0 and (b * 2) * bytes_per_row <= budget:
+        b *= 2
+    return b
+
+
+def _fwd_kernel(v_ref, w_ref, x_ref, out_ref):
+    v = v_ref[...]                      # [bB, K, L]
+    w = w_ref[...]                      # [bB, L]
+    x = x_ref[...]                      # [bB, L]
+    z = v * x[:, None, :]
+    s = jnp.sum(z, axis=-1)             # [bB, K]
+    q = jnp.sum(z * z, axis=-1)         # [bB, K]
+    linear = jnp.sum(w * x, axis=-1)    # [bB]
+    pair = 0.5 * jnp.sum(s * s - q, axis=-1)
+    out_ref[...] = (linear + pair)[:, None]
+
+
+def _bwd_kernel(v_ref, w_ref, x_ref, g_ref, dv_ref, dw_ref, dx_ref):
+    v = v_ref[...]                      # [bB, K, L]
+    w = w_ref[...]
+    x = x_ref[...]
+    g = g_ref[...]                      # [bB, 1]
+    z = v * x[:, None, :]
+    s = jnp.sum(z, axis=-1, keepdims=True)  # [bB, K, 1]
+    sv = s - z                              # [bB, K, L]
+    dv_ref[...] = g[:, :, None] * x[:, None, :] * sv
+    dw_ref[...] = g * x
+    dx_ref[...] = g * (w + jnp.sum(v * sv, axis=1))
+
+
+def _fm_pallas_raw(v: jax.Array, w: jax.Array, x: jax.Array) -> jax.Array:
+    B, K, L = v.shape
+    bB = _block_b(B, K, L)
+    out = pl.pallas_call(
+        _fwd_kernel,
+        grid=(B // bB,),
+        in_specs=[
+            pl.BlockSpec((bB, K, L), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bB, L), lambda i: (i, 0)),
+            pl.BlockSpec((bB, L), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bB, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, 1), v.dtype),
+        interpret=_interpret(),
+    )(v, w, x)
+    return out[:, 0]
+
+
+@jax.custom_vjp
+def fm_scores_pallas(v: jax.Array, w: jax.Array, x: jax.Array) -> jax.Array:
+    """Fused FM forward: scores[B] from v[B,K,L], w[B,L], x[B,L]."""
+    return _fm_pallas_raw(v, w, x)
+
+
+def _fm_fwd(v, w, x):
+    return _fm_pallas_raw(v, w, x), (v, w, x)
+
+
+def _fm_bwd(res, g):
+    v, w, x = res
+    B, K, L = v.shape
+    bB = _block_b(B, K, L)
+    dv, dw, dx = pl.pallas_call(
+        _bwd_kernel,
+        grid=(B // bB,),
+        in_specs=[
+            pl.BlockSpec((bB, K, L), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bB, L), lambda i: (i, 0)),
+            pl.BlockSpec((bB, L), lambda i: (i, 0)),
+            pl.BlockSpec((bB, 1), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bB, K, L), lambda i: (i, 0, 0)),
+            pl.BlockSpec((bB, L), lambda i: (i, 0)),
+            pl.BlockSpec((bB, L), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, L), v.dtype),
+            jax.ShapeDtypeStruct((B, L), w.dtype),
+            jax.ShapeDtypeStruct((B, L), x.dtype),
+        ],
+        interpret=_interpret(),
+    )(v, w, x, g[:, None])
+    return dv, dw, dx
+
+
+fm_scores_pallas.defvjp(_fm_fwd, _fm_bwd)
+
+
+def fm_batch_scores_pallas(params: jax.Array, local_idx: jax.Array,
+                           vals: jax.Array) -> jax.Array:
+    """Drop-in for ops.interaction.fm_batch_scores (order=2) with the
+    interaction fused in Pallas. The [U, K+1] -> [B, L, K+1] gather (and
+    its scatter-add transpose in the VJP) stays in XLA, which lowers
+    both optimally; the kernel owns everything after the gather, in the
+    lane-friendly [B, K, L] layout."""
+    rows = params[local_idx]
+    v = jnp.swapaxes(rows[..., :-1], 1, 2)   # [B, K, L]
+    w = rows[..., -1]
+    return fm_scores_pallas(v, w, vals)

@@ -71,6 +71,18 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(grid, ("data", "model"))
 
 
+def _xla_kernel(spec: ModelSpec) -> ModelSpec:
+    """Mesh paths always use the XLA scorer: GSPMD has no partitioning
+    rule for a pallas_call custom call, so kernel='pallas' under the
+    sharded jit would either fail to lower or silently replicate the
+    batch onto every device. The XLA path fuses well under GSPMD; the
+    Pallas kernel is the single-device fast path."""
+    if spec.kernel == "xla":
+        return spec
+    import dataclasses
+    return dataclasses.replace(spec, kernel="xla")
+
+
 def _layout(mesh: Mesh):
     """The one encoding of the sharding layout: (row, vec, mat, repl) =
     (table rows, per-example vectors, per-example matrices, replicated)."""
@@ -97,6 +109,7 @@ def make_sharded_train_step(spec: ModelSpec, mesh: Mesh,
     the whole mesh, loss replicated. Cached per (spec, mesh)."""
     if with_fields is None:
         with_fields = spec.model_type == "ffm"
+    spec = _xla_kernel(spec)
     in_sh, out_sh = _shardings(mesh, with_fields)
     fn = functools.partial(train_step_body, spec)
     jitted = jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh,

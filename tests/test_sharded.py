@@ -160,3 +160,18 @@ def test_export_npz_slices_padded_table(tmp_path):
     assert arr.shape == (cfg.vocabulary_size, cfg.row_dim)
     np.testing.assert_allclose(
         arr, np.asarray(table_s)[:cfg.vocabulary_size])
+
+
+def test_pallas_spec_coerced_to_xla_on_mesh(tmp_path):
+    """kernel='pallas' must not reach GSPMD (no partitioning rule for
+    pallas_call); the sharded step silently uses the XLA scorer."""
+    path = _write_data(tmp_path, n=16, seed=13)
+    cfg = _cfg(path, batch_size=16, kernel="pallas")
+    spec = ModelSpec.from_config(cfg)
+    mesh = make_mesh(jax.devices()[:8])
+    table_s, acc_s = init_sharded_state(cfg, mesh)
+    step_s = make_sharded_train_step(spec, mesh)
+    for batch in batch_iterator(cfg, cfg.train_files, training=True):
+        table_s, acc_s, loss, _ = step_s(table_s, acc_s,
+                                         **shard_batch(mesh, **batch_args(batch)))
+    assert np.isfinite(float(loss))
