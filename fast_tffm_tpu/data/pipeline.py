@@ -93,15 +93,28 @@ def _uniq_ladder(batch_size: int, max_l: int) -> List[int]:
 
 def make_device_batch(block: ParsedBlock, cfg: FmConfig,
                       weights: Optional[np.ndarray] = None,
-                      batch_size: Optional[int] = None) -> DeviceBatch:
-    """CSR block -> fixed-shape DeviceBatch (pad + host-side unique)."""
+                      batch_size: Optional[int] = None,
+                      fixed_shape: bool = False) -> DeviceBatch:
+    """CSR block -> fixed-shape DeviceBatch (pad + host-side unique).
+
+    ``fixed_shape`` pins L and U to their ladder maxima instead of
+    fitting this batch — required in multi-process SPMD, where every
+    process must assemble identically-shaped global arrays every step
+    (a process whose local batch picked a smaller bucket would deadlock
+    the collective program).
+    """
     B = batch_size or cfg.batch_size
     n_real = block.batch_size
     if n_real > B:
         raise ValueError(f"block of {n_real} examples exceeds batch_size {B}")
     sizes = block.sizes
     max_l = int(sizes.max()) if n_real else 1
-    L = _ladder_fit(max(max_l, 1), cfg.bucket_ladder)
+    ladder = cfg.bucket_ladder
+    L = ladder[-1] if fixed_shape else _ladder_fit(max(max_l, 1), ladder)
+    if max_l > L:
+        raise ValueError(f"example with {max_l} features exceeds the fixed "
+                         f"bucket {L}; raise bucket_ladder or "
+                         "max_features_per_example")
 
     # Host-side unique (replaces the reference's in-graph tf.unique).
     try:
@@ -109,7 +122,8 @@ def make_device_batch(block: ParsedBlock, cfg: FmConfig,
         uniq, inverse = dedup_ids_fast(block.ids)
     except RuntimeError:  # C++ extension unavailable
         uniq, inverse = np.unique(block.ids, return_inverse=True)
-    U = _ladder_fit(len(uniq) + 1, _uniq_ladder(B, L))
+    uladder = _uniq_ladder(B, L)
+    U = uladder[-1] if fixed_shape else _ladder_fit(len(uniq) + 1, uladder)
 
     uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
     uniq_ids[:len(uniq)] = uniq
@@ -178,7 +192,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    epochs: Optional[int] = None,
                    batch_size: Optional[int] = None,
                    seed: Optional[int] = None,
-                   keep_empty: bool = False) -> Iterator[DeviceBatch]:
+                   keep_empty: bool = False,
+                   fixed_shape: bool = False) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files.
 
     Shuffling is a bounded reservoir of ``cfg.queue_size`` lines, the same
@@ -210,7 +225,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                 lines = [c[0] for c in chunk]
                 w = np.array([c[1] for c in chunk], dtype=np.float32)
                 block = _parse_block(lines, cfg, parse, keep_empty)
-                yield make_device_batch(block, cfg, weights=w, batch_size=B)
+                yield make_device_batch(block, cfg, weights=w, batch_size=B,
+                                        fixed_shape=fixed_shape)
 
         for item in _iter_lines(files, weight_files if training else (),
                                 shard_index, num_shards,
@@ -228,6 +244,20 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             rng.shuffle(buf)
             pending.extend(buf)
         yield from flush_batches(True)
+
+
+def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None
+                ) -> DeviceBatch:
+    """An all-padding batch (num_real=0, zero weights): the SPMD filler a
+    data-exhausted process feeds while peers finish their shards — every
+    term it contributes to loss/grad/reg is exactly zero by the padding
+    invariants above."""
+    block = ParsedBlock(labels=np.zeros(0, np.float32),
+                        poses=np.zeros(1, np.int32),
+                        ids=np.zeros(0, np.int32),
+                        vals=np.zeros(0, np.float32), fields=None)
+    return make_device_batch(block, cfg, batch_size=batch_size,
+                             fixed_shape=True)
 
 
 def prefetch(iterator: Iterator[DeviceBatch],

@@ -2,10 +2,17 @@
 
 The reference builds a gRPC cluster from the config's ``[Cluster]``
 ``ps_hosts``/``worker_hosts`` and runs async PS training (SURVEY.md §3.2/
-§3.3). The TPU-native replacement is ``jax.distributed.initialize``: every
-worker is a JAX process in one synchronous SPMD job; XLA collectives over
-ICI/DCN replace gRPC parameter traffic; there are no ps roles (the table
-is row-sharded across the mesh, parallel/sharded.py).
+§3.3). The TPU-native replacement: every worker is a ``jax.distributed``
+process in ONE synchronous SPMD job; XLA collectives over ICI/DCN replace
+gRPC parameter traffic; there are no ps roles — the table is row-sharded
+across the global mesh (parallel/sharded.py), so the mesh *is* the
+parameter server.
+
+CLI surface parity: ``run_tffm.py train cfg dist_train worker <i>``
+maps worker i onto jax.distributed process i, with ``worker_hosts[0]``
+doubling as the coordinator (the analogue of the reference's chief
+worker). ``ps`` roles are accepted and explained away (run_tffm.py):
+a job that listed N ps hosts simply doesn't start them.
 """
 
 from __future__ import annotations
@@ -15,27 +22,59 @@ from typing import Tuple
 from fast_tffm_tpu.config import FmConfig
 
 
+def coordinator_address(cfg: FmConfig) -> str:
+    """worker_hosts[0] with its port shifted up by 1000: the reference's
+    worker port serves TF gRPC; the jax.distributed coordinator needs its
+    own listening port, derived deterministically so every process
+    computes the same address from the shared config."""
+    host = cfg.worker_hosts[0]
+    if ":" in host:
+        name, port = host.rsplit(":", 1)
+        return f"{name}:{int(port) + 1000}"
+    return f"{host}:8476"
+
+
 def init_from_cluster(cfg: FmConfig, job_name: str,
                       task_index: int) -> Tuple[int, int]:
-    """Map the reference's ``dist_train worker <i>`` identity onto a
-    jax.distributed process. Returns (data_shard_index, num_shards) for
-    the input pipeline. Worker 0's host doubles as the coordinator (the
-    analogue of the reference's chief worker; SURVEY §3.2)."""
+    """Join the SPMD job as process ``task_index`` of the cluster in the
+    config. Returns (data_shard_index, num_shards) for the input
+    pipeline (each worker reads a disjoint line shard, the analogue of
+    the reference's per-worker file shards; SURVEY §3.2)."""
     if job_name != "worker":
         raise ValueError(f"unsupported job_name {job_name!r}; only "
-                         "'worker' exists in the TPU rebuild")
+                         "'worker' exists in the TPU rebuild (ps roles "
+                         "are handled at the CLI)")
     hosts = cfg.worker_hosts
     if len(hosts) <= 1:
         return 0, 1
     if not 0 <= task_index < len(hosts):
         raise ValueError(f"task_index {task_index} out of range for "
                          f"{len(hosts)} worker_hosts")
-    # Gradient/table synchronization across processes rides the sharded
-    # train step (parallel/sharded.py) under a global mesh; until the
-    # train driver wires that in for multi-process runs, refusing is
-    # strictly better than N silently-independent replicas racing on one
-    # checkpoint directory.
-    raise NotImplementedError(
-        "multi-process dist_train is not wired up yet: single-process "
-        "multi-device training (one host of a TPU slice) is supported via "
-        "the sharded train step; run one process or shard files manually")
+    import os
+
+    import jax
+    import jax.extend.backend
+    # Backends may already exist (this environment's sitecustomize
+    # resolves them at interpreter startup): distributed state and
+    # collectives config only apply at client creation, so clear first.
+    jax.extend.backend.clear_backends()
+    # Re-assert the operator's platform choice: the sitecustomize layer
+    # can override the JAX_PLATFORMS env var at import time, which would
+    # make every worker race for the same tunnelled TPU chip instead of
+    # forming the requested (e.g. CPU smoke) cluster.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # CPU processes need an explicit collectives backend to federate into
+    # one device namespace (TPU slices federate natively over ICI/DCN;
+    # this setting only affects the CPU client, e.g. the localhost
+    # smoke-cluster test, SURVEY §4).
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address(cfg),
+        num_processes=len(hosts),
+        process_id=task_index)
+    if jax.process_count() != len(hosts):
+        raise RuntimeError(
+            "jax.distributed did not federate the cluster: expected "
+            f"{len(hosts)} processes, got {jax.process_count()}")
+    return task_index, len(hosts)

@@ -133,6 +133,7 @@ def make_sharded_score_fn(spec: ModelSpec, mesh: Mesh,
     """Sharded inference: row-sharded table in, batch-sharded scores out."""
     if with_fields is None:
         with_fields = spec.model_type == "ffm"
+    spec = _xla_kernel(spec)
     row, vec, mat, _ = _layout(mesh)
     in_sh = [row, vec, mat, mat] + ([mat] if with_fields else [])
 
@@ -181,6 +182,67 @@ def init_sharded_state(cfg: FmConfig, mesh: Mesh, seed: int = 0
         return jnp.concatenate([t, pad], axis=0), a
 
     return jax.jit(init, out_shardings=(row, row))(jax.random.PRNGKey(seed))
+
+
+def place_logical_state(cfg: FmConfig, mesh: Mesh, table, acc
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Lift a logical [num_rows, D] (table, acc) — e.g. restored from a
+    checkpoint written by any topology — onto the mesh, appending the
+    divisibility pad rows (zeros for the table, adagrad_init for the
+    accumulator, both dead by construction)."""
+    row = NamedSharding(mesh, ROW_SPEC)
+    n_pad = padded_num_rows(cfg, mesh) - cfg.num_rows
+
+    def lift(t, a):
+        t = jnp.concatenate(
+            [t, jnp.zeros((n_pad, cfg.row_dim), jnp.float32)], axis=0)
+        a = jnp.concatenate(
+            [a, jnp.full((n_pad, cfg.row_dim), cfg.adagrad_init,
+                         jnp.float32)], axis=0)
+        return t, a
+
+    return jax.jit(lift, out_shardings=(row, row))(
+        jnp.asarray(np.asarray(table), jnp.float32),
+        jnp.asarray(np.asarray(acc), jnp.float32))
+
+
+def global_batch(mesh: Mesh, local_uniq_size: int, **arrays) -> dict:
+    """Assemble per-process local batch arrays into global sharded arrays
+    for multi-process SPMD training.
+
+    Every process calls this with its own (identically-shaped, see
+    pipeline ``fixed_shape``) local batch; the result is one global
+    array per input whose global shape concatenates the process-local
+    batches along dim 0, placed per the mesh's data-axis sharding.
+
+    ``local_idx`` needs care: each process's values index its *local*
+    unique-id block, so they are offset by ``process_index *
+    local_uniq_size`` to index the concatenated global unique axis (each
+    process's pad slot lands inside its own block, which still holds
+    ``pad_id``, so padding semantics survive concatenation).
+
+    Semantic note vs single-process: an id occurring on several
+    processes occupies one unique slot per process, so its Adagrad
+    accumulator gains sum-of-squared per-process grads (not the square
+    of the summed grad) and its L2 reg is counted once per process.
+    This matches per-row-touch semantics of the reference's PS (each
+    worker pushed its own IndexedSlices update; SURVEY §3.2) and is the
+    documented multi-host divergence, far smaller than the reference's
+    async staleness.
+    """
+    import jax
+    p = jax.process_index()
+    _, vec, mat, _ = _layout(mesh)
+    out = {}
+    for name, arr in arrays.items():
+        if arr is None:
+            continue
+        arr = np.asarray(arr)
+        if name == "local_idx":
+            arr = arr + np.int32(p * local_uniq_size)
+        sh = vec if arr.ndim == 1 else mat
+        out[name] = jax.make_array_from_process_local_data(sh, arr)
+    return out
 
 
 def shard_batch(mesh: Mesh, **arrays) -> dict:

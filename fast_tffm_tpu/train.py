@@ -31,16 +31,25 @@ from fast_tffm_tpu.utils.timing import StepTimer
 
 
 def evaluate(cfg: FmConfig, table: jax.Array, files,
-             max_batches: Optional[int] = None) -> Tuple[float, int]:
-    """Streamed AUC over ``files``; returns (auc, n_examples)."""
+             max_batches: Optional[int] = None,
+             mesh=None) -> Tuple[float, int]:
+    """Streamed AUC over ``files``; returns (auc, n_examples). Pass the
+    training mesh to score a row-sharded table in place."""
     spec = ModelSpec.from_config(cfg)
-    score_fn = make_score_fn(spec)
+    if mesh is not None:
+        from fast_tffm_tpu.parallel.sharded import (make_sharded_score_fn,
+                                                    shard_batch)
+        score_fn = make_sharded_score_fn(spec, mesh)
+    else:
+        score_fn = make_score_fn(spec)
     auc = StreamingAUC()
     n = 0
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
+        if mesh is not None:
+            args = shard_batch(mesh, **args)
         scores = np.asarray(score_fn(table, **args))
         auc.update(scores[:batch.num_real], batch.labels[:batch.num_real])
         n += batch.num_real
@@ -65,48 +74,133 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                                                     task_index or 0)
 
     spec = ModelSpec.from_config(cfg)
-    table = init_table(cfg, cfg.seed)
-    acc = init_accumulator(cfg)
+    multi_process = jax.process_count() > 1
+    mesh = None
+    if jax.device_count() > 1:
+        # More than one device (one host of a TPU slice, or the whole
+        # jax.distributed job): row-shard the table over the global mesh
+        # and data-shard the batch (parallel/sharded.py). One device:
+        # the plain jitted step, no mesh machinery.
+        from fast_tffm_tpu.parallel.sharded import (
+            global_batch, init_sharded_state, make_mesh,
+            make_sharded_train_step, place_logical_state, shard_batch)
+        mesh = make_mesh()
+        logger.info("mesh training: %s over %d devices, %d processes",
+                    dict(mesh.shape), jax.device_count(),
+                    jax.process_count())
+
     ckpt = CheckpointState(cfg.model_file)
     global_step = 0
     restored = ckpt.restore(template=checkpoint_template(cfg))
-    if restored is not None:
-        table = jax.device_put(jnp_like(restored["table"], table))
-        acc = jax.device_put(jnp_like(restored["acc"], acc))
-        global_step = int(restored["step"])
-        logger.info("restored checkpoint at step %d", global_step)
+    if mesh is not None:
+        if restored is not None:
+            table, acc = place_logical_state(cfg, mesh, restored["table"],
+                                             restored["acc"])
+            global_step = int(restored["step"])
+            logger.info("restored checkpoint at step %d", global_step)
+        else:
+            table, acc = init_sharded_state(cfg, mesh, cfg.seed)
+        step_fn = make_sharded_train_step(spec, mesh)
+    else:
+        table = init_table(cfg, cfg.seed)
+        acc = init_accumulator(cfg)
+        if restored is not None:
+            table = jax.device_put(jnp_like(restored["table"], table))
+            acc = jax.device_put(jnp_like(restored["acc"], acc))
+            global_step = int(restored["step"])
+            logger.info("restored checkpoint at step %d", global_step)
+        step_fn = make_train_step(spec)
 
-    step_fn = make_train_step(spec)
     timer = StepTimer()
     loss = None
     loss_val = float("nan")
     for epoch in range(cfg.epoch_num):
-        for batch in prefetch(batch_iterator(
-                cfg, cfg.train_files, training=True,
-                weight_files=cfg.weight_files, shard_index=shard_index,
-                num_shards=num_shards, epochs=1, seed=cfg.seed + epoch)):
-            table, acc, loss, _ = step_fn(table, acc, **batch_args(batch))
+        it = prefetch(batch_iterator(
+            cfg, cfg.train_files, training=True,
+            weight_files=cfg.weight_files, shard_index=shard_index,
+            num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
+            fixed_shape=multi_process))
+        while True:
+            batch = next(it, None)
+            if multi_process:
+                # Lockstep: line-index sharding can give processes batch
+                # counts differing by one; every step is a collective
+                # program, so a process that stepped alone would hang
+                # the cluster. Agree on exhaustion each step (tiny
+                # host allgather) and feed all-padding filler batches
+                # (zero weight -> zero loss/grad) until everyone is done.
+                from jax.experimental import multihost_utils
+                mine = batch is None
+                flags = multihost_utils.process_allgather(
+                    np.asarray([mine]))
+                if bool(flags.all()):
+                    break
+                if mine:
+                    from fast_tffm_tpu.data.pipeline import empty_batch
+                    batch = empty_batch(cfg)
+            elif batch is None:
+                break
+            args = batch_args(batch)
+            if multi_process:
+                args = global_batch(mesh, len(batch.uniq_ids), **args)
+            elif mesh is not None:
+                args = shard_batch(mesh, **args)
+            table, acc, loss, _ = step_fn(table, acc, **args)
             global_step += 1
-            timer.tick(batch.num_real)
+            timer.tick(batch.num_real * (jax.process_count()
+                                         if multi_process else 1))
             if cfg.log_steps and global_step % cfg.log_steps == 0:
                 loss_val = float(loss)
                 logger.info(
                     "step %d epoch %d loss %.6f examples/sec %.0f",
                     global_step, epoch, loss_val, timer.examples_per_sec)
             if cfg.save_steps and global_step % cfg.save_steps == 0:
-                ckpt.save(global_step, table, acc)
-        if cfg.validation_files:
-            auc, n = evaluate(cfg, table, cfg.validation_files)
+                ckpt.save(global_step, *logical_state(cfg, table, acc))
+        if cfg.validation_files and not multi_process:
+            auc, n = evaluate(cfg, table, cfg.validation_files, mesh=mesh)
             logger.info("epoch %d validation AUC %.6f over %d examples",
                         epoch, auc, n)
     loss_val = float(loss) if loss is not None else loss_val
-    ckpt.save(global_step, table, acc, force=True)
-    export_npz(table, cfg.model_file + ".npz",
-               vocabulary_size=cfg.vocabulary_size)
+    ckpt.save(global_step, *logical_state(cfg, table, acc), force=True)
+    if multi_process:
+        _chief_finalize(cfg, table, logger)
+    else:
+        export_npz(table, cfg.model_file + ".npz",
+                   vocabulary_size=cfg.vocabulary_size)
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
                 global_step, loss_val, timer.examples_per_sec)
     ckpt.close()
     return table
+
+
+def _chief_finalize(cfg: FmConfig, table: jax.Array, logger) -> None:
+    """Multi-process epilogue: allgather the logical table to hosts (a
+    collective — every process participates), then the chief alone runs
+    validation AUC and writes the dense .npz export with a plain
+    single-device score fn."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    # tiled=True: the sharded table's pieces are concatenated (not
+    # stacked) back into the logical [num_rows, D] array on every host.
+    host_table = multihost_utils.process_allgather(table[:cfg.num_rows],
+                                                   tiled=True)
+    if jax.process_index() == 0:
+        export_npz(host_table, cfg.model_file + ".npz",
+                   vocabulary_size=cfg.vocabulary_size)
+        if cfg.validation_files:
+            local = jnp.asarray(np.asarray(host_table), jnp.float32)
+            auc, n = evaluate(cfg, local, cfg.validation_files)
+            logger.info("final validation AUC %.6f over %d examples",
+                        auc, n)
+    multihost_utils.sync_global_devices("fast_tffm_tpu_finalize")
+
+
+def logical_state(cfg: FmConfig, table: jax.Array, acc: jax.Array):
+    """Checkpoint contract: always store the logical [num_rows, D]
+    arrays, so checkpoints are portable across topologies (mesh runs
+    re-derive their divisibility pad rows on restore via
+    place_logical_state; single-device runs match directly)."""
+    return table[:cfg.num_rows], acc[:cfg.num_rows]
 
 
 def jnp_like(host_arr, like: jax.Array):

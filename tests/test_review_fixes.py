@@ -64,9 +64,17 @@ def test_kernel_validated():
         FmConfig(kernel="cuda")
 
 
-def test_multiworker_refused():
-    from fast_tffm_tpu.parallel.distributed import init_from_cluster
-    cfg = FmConfig(worker_hosts=("a:1", "b:2"))
-    with pytest.raises(NotImplementedError):
-        init_from_cluster(cfg, "worker", 1)
+def test_cluster_wiring_surface():
+    from fast_tffm_tpu.parallel.distributed import (coordinator_address,
+                                                    init_from_cluster)
+    # Single-host cluster: no jax.distributed, trivial shard.
     assert init_from_cluster(FmConfig(), "worker", 0) == (0, 1)
+    cfg = FmConfig(worker_hosts=("a:2230", "b:2230"))
+    # Coordinator is chief worker's host on a shifted port (the worker
+    # port itself belongs to the reference's gRPC surface).
+    assert coordinator_address(cfg) == "a:3230"
+    assert coordinator_address(FmConfig(worker_hosts=("a",))) == "a:8476"
+    with pytest.raises(ValueError, match="out of range"):
+        init_from_cluster(cfg, "worker", 5)
+    with pytest.raises(ValueError, match="job_name"):
+        init_from_cluster(cfg, "ps", 0)
