@@ -1,0 +1,31 @@
+# Build-system parity with the reference's Makefile (SURVEY.md §2 "Build
+# system"): the reference compiles its C++ TF ops into a shared object;
+# here the only ahead-of-time artifact is the C++ parser/dedup extension
+# (the TPU compute kernels are JIT-compiled by XLA/Pallas at runtime).
+#
+#   make            build the parser extension
+#   make test       run the test suite
+#   make bench      run the benchmark (one JSON line)
+#   make clean
+
+CXX ?= g++
+CXXFLAGS ?= -O3 -march=native -std=c++17 -shared -fPIC -pthread
+
+SO := fast_tffm_tpu/data/_parser.so
+SRC := fast_tffm_tpu/data/_parser.cc
+
+all: $(SO)
+
+$(SO): $(SRC)
+	$(CXX) $(CXXFLAGS) -o $@ $<
+
+test: $(SO)
+	python -m pytest tests/ -q
+
+bench: $(SO)
+	python bench.py
+
+clean:
+	rm -f $(SO)
+
+.PHONY: all test bench clean

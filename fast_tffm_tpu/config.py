@@ -64,6 +64,11 @@ class FmConfig:
     max_features_per_example: int = 256   # hard cap on nnz/example (truncate)
     bucket_ladder: Tuple[int, ...] = (8, 16, 32, 64, 128, 256)
     kernel: str = "xla"             # "xla" | "pallas"
+    # Profiling (SURVEY §5 "Tracing": reference has none; we dump a
+    # TensorBoard/Perfetto trace of a steady-state step window on demand):
+    profile_dir: str = ""           # empty = profiling off
+    profile_start_step: int = 5     # skip compile/warmup steps
+    profile_num_steps: int = 10
 
     # --- [Predict] ---------------------------------------------------------
     predict_files: Tuple[str, ...] = ()
@@ -148,6 +153,9 @@ _TRAIN_KEYS = {
     "log_steps": int,
     "max_features_per_example": int,
     "kernel": str,
+    "profile_dir": str,
+    "profile_start_step": int,
+    "profile_num_steps": int,
 }
 _PREDICT_KEYS = {
     "predict_files": _split_files,

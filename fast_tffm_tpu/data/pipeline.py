@@ -252,10 +252,11 @@ def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None
     data-exhausted process feeds while peers finish their shards — every
     term it contributes to loss/grad/reg is exactly zero by the padding
     invariants above."""
+    fields = (np.zeros(0, np.int32) if cfg.model_type == "ffm" else None)
     block = ParsedBlock(labels=np.zeros(0, np.float32),
                         poses=np.zeros(1, np.int32),
                         ids=np.zeros(0, np.int32),
-                        vals=np.zeros(0, np.float32), fields=None)
+                        vals=np.zeros(0, np.float32), fields=fields)
     return make_device_batch(block, cfg, batch_size=batch_size,
                              fixed_shape=True)
 

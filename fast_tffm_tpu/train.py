@@ -13,7 +13,7 @@ a device mesh (parallel/), with the table row-sharded across it; the
 
 from __future__ import annotations
 
-import time
+import signal
 from typing import Optional, Tuple
 
 import jax
@@ -27,7 +27,7 @@ from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_score_fn,
                                      make_train_step)
 from fast_tffm_tpu.utils.logging import get_logger
-from fast_tffm_tpu.utils.timing import StepTimer
+from fast_tffm_tpu.utils.timing import StepTimer, trace_span
 
 
 def evaluate(cfg: FmConfig, table: jax.Array, files,
@@ -89,15 +89,25 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     dict(mesh.shape), jax.device_count(),
                     jax.process_count())
 
+    if multi_process and cfg.max_features_per_example > cfg.bucket_ladder[-1]:
+        # fixed_shape batches cap L at the ladder top; catching an
+        # over-long example lazily mid-run would kill one worker between
+        # collectives and hang its peers, so refuse up front.
+        raise ValueError(
+            f"multi-process training needs max_features_per_example "
+            f"({cfg.max_features_per_example}) <= bucket_ladder max "
+            f"({cfg.bucket_ladder[-1]})")
+
     ckpt = CheckpointState(cfg.model_file)
     global_step = 0
     restored = ckpt.restore(template=checkpoint_template(cfg))
+    if restored is not None:
+        global_step = int(restored["step"])
+        logger.info("restored checkpoint at step %d", global_step)
     if mesh is not None:
         if restored is not None:
             table, acc = place_logical_state(cfg, mesh, restored["table"],
                                              restored["acc"])
-            global_step = int(restored["step"])
-            logger.info("restored checkpoint at step %d", global_step)
         else:
             table, acc = init_sharded_state(cfg, mesh, cfg.seed)
         step_fn = make_sharded_train_step(spec, mesh)
@@ -107,14 +117,51 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         if restored is not None:
             table = jax.device_put(jnp_like(restored["table"], table))
             acc = jax.device_put(jnp_like(restored["acc"], acc))
-            global_step = int(restored["step"])
-            logger.info("restored checkpoint at step %d", global_step)
         step_fn = make_train_step(spec)
+
+    # Preemption handling (SURVEY §5 "Failure detection": the reference
+    # only recovers via restart+restore; we additionally save on the way
+    # down). SIGTERM/SIGINT sets a flag the loop drains at the next step
+    # boundary — in multi-process mode the flag rides the lockstep
+    # allgather so every process saves/exits together even when only one
+    # received the signal.
+    preempted: list = []
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(
+                sig, lambda s, f: preempted.append(s))
+        except ValueError:  # not the main thread (e.g. under a test)
+            pass
+
+    profiling = False
+    run_start_step = global_step  # profile window counts THIS run's steps
+    # (a resumed job would otherwise skip past the window silently)
+
+    def profile_tick(step_done: int) -> None:
+        nonlocal profiling
+        if not cfg.profile_dir or jax.process_index() != 0:
+            return
+        step_done -= run_start_step
+        if (not profiling and step_done >= cfg.profile_start_step
+                and step_done < cfg.profile_start_step
+                + cfg.profile_num_steps):
+            jax.profiler.start_trace(cfg.profile_dir)
+            profiling = True
+        elif profiling and step_done >= (cfg.profile_start_step
+                                         + cfg.profile_num_steps):
+            jax.block_until_ready(table)
+            jax.profiler.stop_trace()
+            profiling = False
+            logger.info("profiler trace written to %s", cfg.profile_dir)
 
     timer = StepTimer()
     loss = None
     loss_val = float("nan")
+    stopping = False
     for epoch in range(cfg.epoch_num):
+        if stopping:
+            break
         it = prefetch(batch_iterator(
             cfg, cfg.train_files, training=True,
             weight_files=cfg.weight_files, shard_index=shard_index,
@@ -126,29 +173,40 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 # Lockstep: line-index sharding can give processes batch
                 # counts differing by one; every step is a collective
                 # program, so a process that stepped alone would hang
-                # the cluster. Agree on exhaustion each step (tiny
-                # host allgather) and feed all-padding filler batches
-                # (zero weight -> zero loss/grad) until everyone is done.
+                # the cluster. Agree on exhaustion/preemption each step
+                # (tiny host allgather) and feed all-padding filler
+                # batches (zero weight -> zero loss/grad) until everyone
+                # is done.
                 from jax.experimental import multihost_utils
-                mine = batch is None
                 flags = multihost_utils.process_allgather(
-                    np.asarray([mine]))
-                if bool(flags.all()):
+                    np.asarray([batch is None, bool(preempted)]))
+                if bool(flags[..., 1].any()):
+                    stopping = True
+                    logger.info("preemption signalled; saving and exiting")
                     break
-                if mine:
+                if bool(flags[..., 0].all()):
+                    break
+                if batch is None:
                     from fast_tffm_tpu.data.pipeline import empty_batch
                     batch = empty_batch(cfg)
-            elif batch is None:
-                break
+            else:
+                if preempted:
+                    stopping = True
+                    logger.info("preemption signalled; saving and exiting")
+                    break
+                if batch is None:
+                    break
             args = batch_args(batch)
             if multi_process:
                 args = global_batch(mesh, len(batch.uniq_ids), **args)
             elif mesh is not None:
                 args = shard_batch(mesh, **args)
-            table, acc, loss, _ = step_fn(table, acc, **args)
+            with trace_span("train_step"):
+                table, acc, loss, _ = step_fn(table, acc, **args)
             global_step += 1
             timer.tick(batch.num_real * (jax.process_count()
                                          if multi_process else 1))
+            profile_tick(global_step)
             if cfg.log_steps and global_step % cfg.log_steps == 0:
                 loss_val = float(loss)
                 logger.info(
@@ -156,10 +214,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     global_step, epoch, loss_val, timer.examples_per_sec)
             if cfg.save_steps and global_step % cfg.save_steps == 0:
                 ckpt.save(global_step, *logical_state(cfg, table, acc))
-        if cfg.validation_files and not multi_process:
+        if cfg.validation_files and not multi_process and not stopping:
             auc, n = evaluate(cfg, table, cfg.validation_files, mesh=mesh)
             logger.info("epoch %d validation AUC %.6f over %d examples",
                         epoch, auc, n)
+    if profiling:  # window ran past the end of training
+        jax.profiler.stop_trace()
     loss_val = float(loss) if loss is not None else loss_val
     ckpt.save(global_step, *logical_state(cfg, table, acc), force=True)
     if multi_process:
@@ -167,6 +227,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     else:
         export_npz(table, cfg.model_file + ".npz",
                    vocabulary_size=cfg.vocabulary_size)
+    # Handlers stay installed (absorbing re-signals) until the final
+    # checkpoint/export is safely on disk — the window a second SIGTERM
+    # is most likely to arrive in.
+    for sig, h in prev_handlers.items():
+        signal.signal(sig, h)
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
                 global_step, loss_val, timer.examples_per_sec)
     ckpt.close()
