@@ -352,6 +352,220 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
   return 0;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batch builder: raw byte chunks -> one fully padded device batch in a
+// single pass (parse + hash + dedup + padded scatter). This is the hot
+// host path for throughput training (bench.py): it replaces the Python
+// per-line iteration, the str join/encode, np.unique and the fancy-index
+// scatter of the generic path. Resumable across feed() calls so the
+// caller can stream arbitrary chunk sizes; the dedup hash map is stamped
+// per batch (no per-batch clears).
+//
+// Padding convention: unique slot 0 is RESERVED for pad_id (== vocab);
+// real uniques start at slot 1, and padded local_idx cells are 0. (The
+// generic Python path pads at slot U-1; both satisfy the documented
+// invariant "padding cells point at a slot holding pad_id".)
+// ---------------------------------------------------------------------------
+
+struct BatchBuilder {
+  int64_t B, L, vocab;
+  bool hash_ids;
+  int max_feats;
+  std::vector<float> labels;    // [B]
+  std::vector<int32_t> uniq;    // [B*L + 1]
+  std::vector<int32_t> li;      // [B*L], default 0 (pad slot)
+  std::vector<float> vals;      // [B*L], default 0
+  std::vector<int32_t> slot;    // dedup table -> slot index
+  std::vector<uint32_t> stamp;  // dedup table stamping
+  uint32_t cur_stamp = 0;
+  uint32_t mask = 0;
+  int64_t n_ex = 0;
+  int32_t n_uniq = 1;  // slot 0 = pad
+  int32_t max_nnz = 0;
+  int64_t lineno = 0;
+  std::string error;
+};
+
+namespace {
+
+void bb_reset(BatchBuilder* bb) {
+  bb->n_ex = 0;
+  bb->n_uniq = 1;
+  bb->max_nnz = 0;
+  bb->cur_stamp++;
+  std::memset(bb->li.data(), 0, size_t(bb->B * bb->L) * sizeof(int32_t));
+  std::memset(bb->vals.data(), 0, size_t(bb->B * bb->L) * sizeof(float));
+}
+
+inline int32_t bb_slot(BatchBuilder* bb, int32_t key) {
+  uint32_t h = (uint32_t(key) * 2654435761u) & bb->mask;
+  for (;;) {
+    if (bb->stamp[h] != bb->cur_stamp) {
+      bb->stamp[h] = bb->cur_stamp;
+      bb->slot[h] = bb->n_uniq;
+      bb->uniq[size_t(bb->n_uniq)] = key;
+      return bb->n_uniq++;
+    }
+    if (bb->uniq[size_t(bb->slot[h])] == key) return bb->slot[h];
+    h = (h + 1) & bb->mask;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
+                int max_feats) {
+  if (B <= 0 || L <= 0 || vocab <= 0) return nullptr;
+  auto* bb = new BatchBuilder();
+  bb->B = B;
+  bb->L = L;
+  bb->vocab = vocab;
+  bb->hash_ids = hash_ids != 0;
+  bb->max_feats = (max_feats > 0 && max_feats < L) ? max_feats : int(L);
+  bb->labels.resize(size_t(B));
+  bb->uniq.resize(size_t(B * L + 1));
+  bb->uniq[0] = int32_t(vocab);  // pad slot
+  bb->li.assign(size_t(B * L), 0);
+  bb->vals.assign(size_t(B * L), 0.0f);
+  size_t cap = 16;
+  while (cap < size_t(B * L) * 2) cap <<= 1;
+  bb->mask = uint32_t(cap - 1);
+  bb->slot.resize(cap);
+  bb->stamp.assign(cap, 0);
+  bb->cur_stamp = 1;
+  return bb;
+}
+
+void fm_bb_free(void* h) { delete static_cast<BatchBuilder*>(h); }
+
+// Parse lines from blob until the batch has B examples or the blob's
+// complete lines are exhausted. Only whole lines (ending in '\n') are
+// consumed — the caller carries the tail bytes into its next chunk.
+// Returns 1 when the batch is full, 0 for "feed me more", -1 on parse
+// error (message in err_out).
+int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
+               int64_t* consumed_out, char* err_out, int64_t err_cap) {
+  auto* bb = static_cast<BatchBuilder*>(h);
+  const char* p = blob;
+  const char* end = blob + blob_len;
+  while (bb->n_ex < bb->B) {
+    const char* line_end = static_cast<const char*>(
+        std::memchr(p, '\n', size_t(end - p)));
+    if (line_end == nullptr) break;  // partial line: leave for next chunk
+    const char* q = p;
+    bb->lineno++;
+    while (q < line_end && is_ws(*q)) q++;
+    if (q == line_end) {  // blank line: skipped (training path)
+      p = line_end + 1;
+      continue;
+    }
+    const char* tok_end = q;
+    while (tok_end < line_end && !is_ws(*tok_end)) tok_end++;
+    float label;
+    if (!parse_float(q, tok_end, &label)) {
+      std::snprintf(err_out, size_t(err_cap), "line %lld: bad label '%.*s'",
+                    (long long)bb->lineno, int(tok_end - q), q);
+      return -1;
+    }
+    float* vrow = bb->vals.data() + bb->n_ex * bb->L;
+    int32_t* irow = bb->li.data() + bb->n_ex * bb->L;
+    int n_feats = 0;
+    q = tok_end;
+    while (true) {
+      while (q < line_end && is_ws(*q)) q++;
+      if (q >= line_end) break;
+      tok_end = q;
+      const char* colon = nullptr;
+      bool extra_colon = false;
+      while (tok_end < line_end && !is_ws(*tok_end)) {
+        if (*tok_end == ':') {
+          if (colon != nullptr) extra_colon = true;
+          else colon = tok_end;
+        }
+        tok_end++;
+      }
+      if (n_feats >= bb->max_feats) {  // cap: skip tail like Python
+        q = tok_end;
+        continue;
+      }
+      if (extra_colon) {
+        std::snprintf(err_out, size_t(err_cap),
+                      "line %lld: bad token '%.*s' (want fid[:val])",
+                      (long long)bb->lineno, int(tok_end - q), q);
+        return -1;
+      }
+      const char* fid_end = colon ? colon : tok_end;
+      int32_t row;
+      if (bb->hash_ids) {
+        row = int32_t(murmur64(q, size_t(fid_end - q), 0) %
+                      uint64_t(bb->vocab));
+      } else {
+        int64_t fid;
+        if (!parse_int(q, fid_end, &fid)) {
+          std::snprintf(err_out, size_t(err_cap),
+                        "line %lld: non-integer feature id '%.*s' (set "
+                        "hash_feature_id = True for string ids)",
+                        (long long)bb->lineno, int(fid_end - q), q);
+          return -1;
+        }
+        if (fid < 0 || fid >= bb->vocab) {
+          std::snprintf(err_out, size_t(err_cap),
+                        "line %lld: feature id %lld out of range [0, %lld)",
+                        (long long)bb->lineno, (long long)fid,
+                        (long long)bb->vocab);
+          return -1;
+        }
+        row = int32_t(fid);
+      }
+      float val = 1.0f;
+      if (colon != nullptr && !parse_float(colon + 1, tok_end, &val)) {
+        std::snprintf(err_out, size_t(err_cap), "line %lld: bad value '%.*s'",
+                      (long long)bb->lineno, int(tok_end - colon - 1),
+                      colon + 1);
+        return -1;
+      }
+      irow[n_feats] = bb_slot(bb, row);
+      vrow[n_feats] = val;
+      n_feats++;
+      q = tok_end;
+    }
+    bb->labels[size_t(bb->n_ex)] = label;
+    if (n_feats > bb->max_nnz) bb->max_nnz = n_feats;
+    bb->n_ex++;
+    p = line_end + 1;
+  }
+  *consumed_out = p - blob;
+  return bb->n_ex >= bb->B ? 1 : 0;
+}
+
+// Copy the accumulated batch out and reset for the next one.
+// labels_out[B], uniq_out[n_uniq] (slot 0 = pad_id), li_out[B*L],
+// vals_out[B*L]. Returns n_examples (0 if the batch is empty).
+int64_t fm_bb_finish(void* h, float* labels_out, int32_t* uniq_out,
+                     int32_t* li_out, float* vals_out, int64_t* n_uniq_out,
+                     int64_t* max_nnz_out) {
+  auto* bb = static_cast<BatchBuilder*>(h);
+  const int64_t n = bb->n_ex;
+  std::memcpy(labels_out, bb->labels.data(), size_t(n) * sizeof(float));
+  std::memcpy(uniq_out, bb->uniq.data(),
+              size_t(bb->n_uniq) * sizeof(int32_t));
+  std::memcpy(li_out, bb->li.data(), size_t(bb->B * bb->L) * sizeof(int32_t));
+  std::memcpy(vals_out, bb->vals.data(),
+              size_t(bb->B * bb->L) * sizeof(float));
+  *n_uniq_out = bb->n_uniq;
+  *max_nnz_out = bb->max_nnz;
+  bb_reset(bb);
+  return n;
+}
+
+}  // extern "C"
+
+extern "C" {
+
 // First-occurrence-order unique + inverse over a batch's feature ids —
 // the hot host-side replacement for np.unique(return_inverse=True), which
 // is sort-based and dominates batch-build time at Criteo shapes (~320k

@@ -57,6 +57,10 @@ def _load() -> ctypes.CDLL:
             # newer entry point must route to the fallback path too.
             lib.fm_parse_block
             lib.fm_dedup_ids
+            lib.fm_bb_new
+            lib.fm_bb_feed
+            lib.fm_bb_finish
+            lib.fm_bb_free
         except (OSError, FileNotFoundError, AttributeError,
                 subprocess.CalledProcessError) as e:
             _load_error = f"C++ parser unavailable: {e}"
@@ -81,6 +85,23 @@ def _load() -> ctypes.CDLL:
             np.ctypeslib.ndpointer(np.int32),             # uniq out
             np.ctypeslib.ndpointer(np.int32),             # inverse out
         ]
+        lib.fm_bb_new.restype = ctypes.c_void_p
+        lib.fm_bb_new.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                  ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+        lib.fm_bb_free.argtypes = [ctypes.c_void_p]
+        lib.fm_bb_feed.restype = ctypes.c_int
+        lib.fm_bb_feed.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p, ctypes.c_int64]
+        lib.fm_bb_finish.restype = ctypes.c_int64
+        lib.fm_bb_finish.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.float32),           # labels
+            np.ctypeslib.ndpointer(np.int32),             # uniq
+            np.ctypeslib.ndpointer(np.int32),             # local_idx
+            np.ctypeslib.ndpointer(np.float32),           # vals
+            ctypes.POINTER(ctypes.c_int64),               # n_uniq
+            ctypes.POINTER(ctypes.c_int64)]               # max_nnz
         _lib = lib
         return lib
 
@@ -124,6 +145,67 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
     z = nnz.value
     return ParsedBlock(labels=labels[:b].copy(), poses=poses[:b + 1].copy(),
                        ids=ids[:z].copy(), vals=vals[:z].copy(), fields=None)
+
+
+class BatchBuilder:
+    """Streaming raw-bytes -> padded-batch builder (C++ `fm_bb_*`).
+
+    ``feed(chunk)`` consumes whole lines until the batch holds B
+    examples, returning True when full (unconsumed tail bytes of the
+    chunk must be re-fed). ``finish()`` returns the padded arrays —
+    labels [B], uniq [n_uniq] with slot 0 = pad_id, local_idx [B, L],
+    vals [B, L] — and resets for the next batch. One parse pass does
+    parse + hash + dedup + padded scatter; there is no per-line Python.
+    """
+
+    def __init__(self, batch_size: int, max_cols: int,
+                 vocabulary_size: int, hash_feature_id: bool = False,
+                 max_features_per_example: int = 0):
+        self._lib = _load()
+        self.B, self.L = batch_size, max_cols
+        self._h = self._lib.fm_bb_new(batch_size, max_cols,
+                                      vocabulary_size,
+                                      int(hash_feature_id),
+                                      max_features_per_example)
+        if not self._h:
+            raise RuntimeError("fm_bb_new failed (bad sizes)")
+        self._err = ctypes.create_string_buffer(512)
+
+    def feed(self, chunk: bytes, offset: int = 0) -> "tuple[bool, int]":
+        """Feed ``chunk[offset:]`` (zero-copy via pointer arithmetic —
+        the caller re-feeds from a moving offset after each full batch).
+        Returns (batch_full, bytes_consumed)."""
+        base = ctypes.cast(ctypes.c_char_p(chunk), ctypes.c_void_p).value
+        consumed = ctypes.c_int64(0)
+        rc = self._lib.fm_bb_feed(self._h,
+                                  ctypes.c_void_p((base or 0) + offset),
+                                  len(chunk) - offset,
+                                  ctypes.byref(consumed), self._err,
+                                  len(self._err))
+        if rc < 0:
+            raise ParseError(self._err.value.decode("utf-8", "replace"))
+        return rc == 1, consumed.value
+
+    def finish(self):
+        """-> (n_examples, labels[B], uniq[n_uniq], local_idx[B,L],
+        vals[B,L], max_nnz); resets the builder."""
+        labels = np.empty(self.B, np.float32)
+        uniq = np.empty(self.B * self.L + 1, np.int32)
+        li = np.empty((self.B, self.L), np.int32)
+        vals = np.empty((self.B, self.L), np.float32)
+        n_uniq = ctypes.c_int64(0)
+        max_nnz = ctypes.c_int64(0)
+        n = self._lib.fm_bb_finish(self._h, labels, uniq, li, vals,
+                                   ctypes.byref(n_uniq),
+                                   ctypes.byref(max_nnz))
+        return (int(n), labels, uniq[:n_uniq.value].copy(), li, vals,
+                int(max_nnz.value))
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.fm_bb_free(h)
+            self._h = None
 
 
 def dedup_ids_fast(ids: np.ndarray):

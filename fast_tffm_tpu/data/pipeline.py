@@ -185,6 +185,90 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                 wfh.close()
 
 
+def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
+                         n_epochs: int, shuffle: bool,
+                         seed: Optional[int],
+                         fixed_shape: bool) -> Iterator[DeviceBatch]:
+    """Chunked C++ fast path: raw file bytes stream straight into the
+    C++ BatchBuilder (parse + hash + dedup + padded scatter in one native
+    pass); Python never touches individual lines.
+
+    Shuffle here is a window-of-batches pick plus a within-batch row
+    permutation — the same mixing radius as the reference's bounded
+    shuffle queue of ``queue_size`` lines (SURVEY §2 "Input pipeline"),
+    expressed at batch granularity. Exact reservoir-per-line semantics
+    remain on the generic path (weight files / FFM / sharded input / the
+    Python parser force it).
+    """
+    L_cap = bb.L
+    pyrng = random.Random(cfg.seed if seed is None else seed)
+    nprng = np.random.default_rng(pyrng.getrandbits(64))
+    window: List[DeviceBatch] = []
+    window_cap = max(2, cfg.queue_size // B) if shuffle else 1
+
+    def emit(n, labels, uniq, li, vals, max_nnz) -> DeviceBatch:
+        L = (L_cap if fixed_shape
+             else _ladder_fit(max(max_nnz, 1), cfg.bucket_ladder))
+        if L < L_cap:
+            li = np.ascontiguousarray(li[:, :L])
+            vals = np.ascontiguousarray(vals[:, :L])
+        uladder = _uniq_ladder(B, L)
+        U = uladder[-1] if fixed_shape else _ladder_fit(len(uniq) + 1,
+                                                        uladder)
+        uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
+        uniq_ids[:len(uniq)] = uniq  # slot 0 already pad_id (C++ layout)
+        weights = np.zeros(B, np.float32)
+        weights[:n] = 1.0
+        labels[n:] = 0.0  # C++ buffer may hold stale labels past n
+        if shuffle and n > 1:
+            # Permute only the real rows: consumers rely on the padding
+            # block staying at the tail ([:num_real] slicing).
+            perm = np.concatenate([nprng.permutation(n),
+                                   np.arange(n, B)])
+            labels, weights = labels[perm], weights[perm]
+            li, vals = li[perm], vals[perm]
+        return DeviceBatch(labels=labels, weights=weights,
+                           uniq_ids=uniq_ids, local_idx=li, vals=vals,
+                           fields=None, num_real=n)
+
+    def drain(batch: DeviceBatch) -> Iterator[DeviceBatch]:
+        if shuffle:
+            window.append(batch)
+            if len(window) >= window_cap:
+                yield window.pop(pyrng.randrange(len(window)))
+        else:
+            yield batch
+
+    for _ in range(n_epochs):
+        for path in files:
+            with open(path, "rb") as fh:
+                tail = b""
+                while True:
+                    chunk = fh.read(4 << 20)
+                    if not chunk:
+                        if not tail:
+                            break
+                        # final line missing its newline
+                        data, tail = tail + b"\n", b""
+                    else:
+                        data, tail = (tail + chunk if tail else chunk), b""
+                    off = 0
+                    while True:
+                        full, consumed = bb.feed(data, off)
+                        off += consumed
+                        if not full:
+                            break
+                        yield from drain(emit(*bb.finish()))
+                    tail = data[off:]
+                    if not chunk:
+                        break
+        n, labels, uniq, li, vals, max_nnz = bb.finish()
+        if n:  # short final batch of the epoch
+            yield from drain(emit(n, labels, uniq, li, vals, max_nnz))
+        while window:
+            yield window.pop(pyrng.randrange(len(window)))
+
+
 def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    training: bool = True,
                    weight_files: Sequence[str] = (),
@@ -209,6 +293,27 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                                                   else 1)
     rng = random.Random(cfg.seed if seed is None else seed)
     do_shuffle = training and cfg.shuffle
+
+    # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
+    # no feature needs per-line Python handling. Requires a hard
+    # per-example cap (the builder writes fixed-stride rows);
+    # max_features_per_example = 0 means "unlimited" and stays generic.
+    if (num_shards == 1 and not keep_empty and not weight_files
+            and cfg.model_type != "ffm"
+            and cfg.max_features_per_example > 0):
+        try:
+            from fast_tffm_tpu.data.cparser import BatchBuilder
+            L_cap = max(cfg.bucket_ladder[-1], cfg.max_features_per_example)
+            bb = BatchBuilder(B, L_cap, cfg.vocabulary_size,
+                              hash_feature_id=cfg.hash_feature_id,
+                              max_features_per_example=(
+                                  cfg.max_features_per_example))
+        except RuntimeError:
+            bb = None  # C++ extension unavailable -> generic path
+        if bb is not None:
+            yield from _fast_batch_iterator(cfg, bb, files, B, n_epochs,
+                                            do_shuffle, seed, fixed_shape)
+            return
     # keep_empty needs blank lines to become zero-feature examples; only
     # the Python parser implements that.
     parse = (None if cfg.model_type == "ffm" or keep_empty

@@ -159,79 +159,88 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     loss = None
     loss_val = float("nan")
     stopping = False
-    for epoch in range(cfg.epoch_num):
-        if stopping:
-            break
-        it = prefetch(batch_iterator(
-            cfg, cfg.train_files, training=True,
-            weight_files=cfg.weight_files, shard_index=shard_index,
-            num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
-            fixed_shape=multi_process))
-        while True:
-            batch = next(it, None)
-            if multi_process:
-                # Lockstep: line-index sharding can give processes batch
-                # counts differing by one; every step is a collective
-                # program, so a process that stepped alone would hang
-                # the cluster. Agree on exhaustion/preemption each step
-                # (tiny host allgather) and feed all-padding filler
-                # batches (zero weight -> zero loss/grad) until everyone
-                # is done.
-                from jax.experimental import multihost_utils
-                flags = multihost_utils.process_allgather(
-                    np.asarray([batch is None, bool(preempted)]))
-                if bool(flags[..., 1].any()):
-                    stopping = True
-                    logger.info("preemption signalled; saving and exiting")
-                    break
-                if bool(flags[..., 0].all()):
-                    break
-                if batch is None:
-                    from fast_tffm_tpu.data.pipeline import empty_batch
-                    batch = empty_batch(cfg)
-            else:
-                if preempted:
-                    stopping = True
-                    logger.info("preemption signalled; saving and exiting")
-                    break
-                if batch is None:
-                    break
-            args = batch_args(batch)
-            if multi_process:
-                args = global_batch(mesh, len(batch.uniq_ids), **args)
-            elif mesh is not None:
-                args = shard_batch(mesh, **args)
-            with trace_span("train_step"):
-                table, acc, loss, _ = step_fn(table, acc, **args)
-            global_step += 1
-            timer.tick(batch.num_real * (jax.process_count()
-                                         if multi_process else 1))
-            profile_tick(global_step)
-            if cfg.log_steps and global_step % cfg.log_steps == 0:
-                loss_val = float(loss)
-                logger.info(
-                    "step %d epoch %d loss %.6f examples/sec %.0f",
-                    global_step, epoch, loss_val, timer.examples_per_sec)
-            if cfg.save_steps and global_step % cfg.save_steps == 0:
-                ckpt.save(global_step, *logical_state(cfg, table, acc))
-        if cfg.validation_files and not multi_process and not stopping:
-            auc, n = evaluate(cfg, table, cfg.validation_files, mesh=mesh)
-            logger.info("epoch %d validation AUC %.6f over %d examples",
-                        epoch, auc, n)
-    if profiling:  # window ran past the end of training
-        jax.profiler.stop_trace()
-    loss_val = float(loss) if loss is not None else loss_val
-    ckpt.save(global_step, *logical_state(cfg, table, acc), force=True)
-    if multi_process:
-        _chief_finalize(cfg, table, logger)
-    else:
-        export_npz(table, cfg.model_file + ".npz",
-                   vocabulary_size=cfg.vocabulary_size)
-    # Handlers stay installed (absorbing re-signals) until the final
-    # checkpoint/export is safely on disk — the window a second SIGTERM
-    # is most likely to arrive in.
-    for sig, h in prev_handlers.items():
-        signal.signal(sig, h)
+    # Handlers stay installed (absorbing re-signals) until the finally
+    # below — i.e. until the final checkpoint/export is safely on disk,
+    # the window a second SIGTERM is most likely to arrive in. The
+    # finally also covers exceptions, so a failed in-process train()
+    # can't leave the surviving process (pytest, REPL, server) with
+    # SIGTERM/SIGINT swallowed into a dead flag list.
+    try:
+        for epoch in range(cfg.epoch_num):
+            if stopping:
+                break
+            it = prefetch(batch_iterator(
+                cfg, cfg.train_files, training=True,
+                weight_files=cfg.weight_files, shard_index=shard_index,
+                num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
+                fixed_shape=multi_process))
+            while True:
+                batch = next(it, None)
+                if multi_process:
+                    # Lockstep: line-index sharding can give processes
+                    # batch counts differing by one; every step is a
+                    # collective program, so a process that stepped alone
+                    # would hang the cluster. Agree on exhaustion/
+                    # preemption each step (tiny host allgather) and feed
+                    # all-padding filler batches (zero weight -> zero
+                    # loss/grad) until everyone is done.
+                    from jax.experimental import multihost_utils
+                    flags = multihost_utils.process_allgather(
+                        np.asarray([batch is None, bool(preempted)]))
+                    if bool(flags[..., 1].any()):
+                        stopping = True
+                        logger.info(
+                            "preemption signalled; saving and exiting")
+                        break
+                    if bool(flags[..., 0].all()):
+                        break
+                    if batch is None:
+                        from fast_tffm_tpu.data.pipeline import empty_batch
+                        batch = empty_batch(cfg)
+                else:
+                    if preempted:
+                        stopping = True
+                        logger.info(
+                            "preemption signalled; saving and exiting")
+                        break
+                    if batch is None:
+                        break
+                args = batch_args(batch)
+                if multi_process:
+                    args = global_batch(mesh, len(batch.uniq_ids), **args)
+                elif mesh is not None:
+                    args = shard_batch(mesh, **args)
+                with trace_span("train_step"):
+                    table, acc, loss, _ = step_fn(table, acc, **args)
+                global_step += 1
+                timer.tick(batch.num_real * (jax.process_count()
+                                             if multi_process else 1))
+                profile_tick(global_step)
+                if cfg.log_steps and global_step % cfg.log_steps == 0:
+                    loss_val = float(loss)
+                    logger.info(
+                        "step %d epoch %d loss %.6f examples/sec %.0f",
+                        global_step, epoch, loss_val,
+                        timer.examples_per_sec)
+                if cfg.save_steps and global_step % cfg.save_steps == 0:
+                    ckpt.save(global_step, *logical_state(cfg, table, acc))
+            if cfg.validation_files and not multi_process and not stopping:
+                auc, n = evaluate(cfg, table, cfg.validation_files,
+                                  mesh=mesh)
+                logger.info("epoch %d validation AUC %.6f over %d examples",
+                            epoch, auc, n)
+        if profiling:  # window ran past the end of training
+            jax.profiler.stop_trace()
+        loss_val = float(loss) if loss is not None else loss_val
+        ckpt.save(global_step, *logical_state(cfg, table, acc), force=True)
+        if multi_process:
+            _chief_finalize(cfg, table, logger)
+        else:
+            export_npz(table, cfg.model_file + ".npz",
+                       vocabulary_size=cfg.vocabulary_size)
+    finally:
+        for sig, h in prev_handlers.items():
+            signal.signal(sig, h)
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
                 global_step, loss_val, timer.examples_per_sec)
     ckpt.close()
