@@ -197,4 +197,19 @@ def load_config(path: str) -> FmConfig:
     consume("Train", _TRAIN_KEYS)
     consume("Predict", _PREDICT_KEYS)
     consume("Cluster", _CLUSTER_KEYS)
-    return FmConfig(**kwargs)
+    cfg = FmConfig(**kwargs)
+    # Reference knobs accepted for config compatibility but with no effect
+    # here — tell the user instead of silently ignoring a tuned value.
+    import warnings
+    if cfg.vocabulary_block_num > 1:
+        warnings.warn(
+            f"vocabulary_block_num = {cfg.vocabulary_block_num} is accepted "
+            "for compatibility but has no effect: the reference used it to "
+            "partition the table across parameter servers; here the device "
+            "mesh decides row sharding (parallel/sharded.py)")
+    if cfg.shuffle_threads > 1:
+        warnings.warn(
+            f"shuffle_threads = {cfg.shuffle_threads} is accepted for "
+            "compatibility but has no effect: shuffling is a deterministic "
+            "bounded reservoir on the input iterator, not a thread pool")
+    return cfg

@@ -303,7 +303,12 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             and cfg.max_features_per_example > 0):
         try:
             from fast_tffm_tpu.data.cparser import BatchBuilder
-            L_cap = max(cfg.bucket_ladder[-1], cfg.max_features_per_example)
+            # A ladder value (power of two past the top), so batches with
+            # max_features_per_example > ladder[-1] land in the same
+            # extended pow2 buckets the generic path compiles for.
+            L_cap = _ladder_fit(
+                max(cfg.bucket_ladder[-1], cfg.max_features_per_example),
+                cfg.bucket_ladder)
             bb = BatchBuilder(B, L_cap, cfg.vocabulary_size,
                               hash_feature_id=cfg.hash_feature_id,
                               max_features_per_example=(

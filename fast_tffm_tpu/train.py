@@ -89,14 +89,17 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     dict(mesh.shape), jax.device_count(),
                     jax.process_count())
 
-    if multi_process and cfg.max_features_per_example > cfg.bucket_ladder[-1]:
+    if multi_process and not (
+            0 < cfg.max_features_per_example <= cfg.bucket_ladder[-1]):
         # fixed_shape batches cap L at the ladder top; catching an
         # over-long example lazily mid-run would kill one worker between
-        # collectives and hang its peers, so refuse up front.
+        # collectives and hang its peers, so refuse up front. 0 means
+        # "unlimited", which can never be honored under a fixed L.
         raise ValueError(
-            f"multi-process training needs max_features_per_example "
+            f"multi-process training needs 0 < max_features_per_example "
             f"({cfg.max_features_per_example}) <= bucket_ladder max "
-            f"({cfg.bucket_ladder[-1]})")
+            f"({cfg.bucket_ladder[-1]}) so over-long examples are "
+            "truncated up front instead of faulting one worker mid-run")
 
     ckpt = CheckpointState(cfg.model_file)
     global_step = 0
@@ -229,8 +232,6 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                                   mesh=mesh)
                 logger.info("epoch %d validation AUC %.6f over %d examples",
                             epoch, auc, n)
-        if profiling:  # window ran past the end of training
-            jax.profiler.stop_trace()
         loss_val = float(loss) if loss is not None else loss_val
         ckpt.save(global_step, *logical_state(cfg, table, acc), force=True)
         if multi_process:
@@ -239,8 +240,20 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             export_npz(table, cfg.model_file + ".npz",
                        vocabulary_size=cfg.vocabulary_size)
     finally:
-        for sig, h in prev_handlers.items():
-            signal.signal(sig, h)
+        try:
+            if profiling:
+                # Window ran past the end of training — or the loop
+                # raised with the window open; either way the trace must
+                # be closed here or the next start_trace in this process
+                # fails with "trace already in progress".
+                jax.profiler.stop_trace()
+                profiling = False
+        finally:
+            # Must run even if stop_trace raises (unwritable profile_dir):
+            # leaving these handlers installed would swallow SIGTERM/
+            # SIGINT into a dead flag list in the surviving process.
+            for sig, h in prev_handlers.items():
+                signal.signal(sig, h)
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
                 global_step, loss_val, timer.examples_per_sec)
     ckpt.close()

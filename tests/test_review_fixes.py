@@ -64,6 +64,74 @@ def test_kernel_validated():
         FmConfig(kernel="cuda")
 
 
+def test_multiprocess_rejects_unlimited_features(tmp_path, monkeypatch):
+    # max_features_per_example = 0 ("unlimited") must be refused up front
+    # in multi-process mode: an over-long example caught lazily mid-run
+    # would kill one worker between collectives and hang its peers.
+    import jax
+    from fast_tffm_tpu.train import train
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    data = tmp_path / "t.txt"
+    data.write_text("1 1:1\n0 2:1\n")
+    cfg = FmConfig(vocabulary_size=8, batch_size=2,
+                   train_files=(str(data),),
+                   model_file=str(tmp_path / "m" / "fm"),
+                   max_features_per_example=0)
+    with pytest.raises(ValueError, match="max_features_per_example"):
+        train(cfg)
+
+
+def test_fast_path_extends_ladder_like_generic(tmp_path):
+    # max_features_per_example past the ladder top: the fast path must
+    # emit the same extended power-of-two bucket the generic path
+    # compiles for (512 here), not a non-ladder width.
+    from fast_tffm_tpu.data.cparser import available
+    from fast_tffm_tpu.data.pipeline import batch_iterator
+    if not available():
+        pytest.skip("C++ parser unavailable")
+    data = tmp_path / "t.txt"
+    long_line = "1 " + " ".join(f"{i}:1" for i in range(300))
+    data.write_text(long_line + "\n0 1:1\n")
+    cfg = FmConfig(vocabulary_size=5000, batch_size=2,
+                   bucket_ladder=(4, 8), max_features_per_example=300,
+                   shuffle=False)
+    batches = list(batch_iterator(cfg, [str(data)], training=True,
+                                  epochs=1))
+    assert batches[0].local_idx.shape[1] == 512
+    assert batches[0].num_real == 2
+
+
+def test_ignored_reference_knobs_warn(tmp_path):
+    from fast_tffm_tpu.config import load_config
+    p = tmp_path / "c.cfg"
+    p.write_text("[General]\nvocabulary_block_num = 100\n"
+                 "[Train]\nshuffle_threads = 4\n")
+    with pytest.warns(UserWarning, match="vocabulary_block_num"):
+        with pytest.warns(UserWarning, match="shuffle_threads"):
+            load_config(str(p))
+
+
+def test_profiler_closed_when_loop_raises(tmp_path):
+    # A parse error mid-loop with the profiler window open must still
+    # stop the trace (finally), or the next start_trace in this process
+    # fails with "trace already in progress".
+    import jax
+    from fast_tffm_tpu.data.parser import ParseError
+    from fast_tffm_tpu.train import train
+    data = tmp_path / "t.txt"
+    good = "".join(f"{i % 2} {i % 5}:1\n" for i in range(8))
+    data.write_text(good + "1 not_an_id:1\n")
+    cfg = FmConfig(vocabulary_size=8, batch_size=8, epoch_num=1,
+                   shuffle=False, train_files=(str(data),),
+                   model_file=str(tmp_path / "m" / "fm"),
+                   profile_dir=str(tmp_path / "prof"),
+                   profile_start_step=0, profile_num_steps=10)
+    with pytest.raises(ParseError):
+        train(cfg)
+    jax.profiler.start_trace(str(tmp_path / "prof2"))  # must not raise
+    jax.profiler.stop_trace()
+
+
 def test_cluster_wiring_surface():
     from fast_tffm_tpu.parallel.distributed import (coordinator_address,
                                                     init_from_cluster)
