@@ -30,11 +30,16 @@ class CheckpointState:
                                                  create=True))
 
     def save(self, step: int, table: jax.Array, acc: jax.Array,
-             force: bool = False) -> None:
+             vocabulary_size: int, force: bool = False) -> None:
+        """``vocabulary_size`` is stored alongside the arrays: the
+        4096-aligned row layout means a changed vocab inside the same
+        bucket would otherwise restore shape-compatibly but silently
+        scramble the pad-row invariant (callers verify on restore)."""
         self._mngr.save(step,
                         args=ocp.args.StandardSave(
                             {"table": table, "acc": acc,
-                             "step": np.int64(step)}),
+                             "step": np.int64(step),
+                             "vocab": np.int64(vocabulary_size)}),
                         force=force)
         self._mngr.wait_until_finished()
 
@@ -53,7 +58,21 @@ class CheckpointState:
             return None
         if template is None:
             return self._mngr.restore(s)
-        return self._mngr.restore(s, args=ocp.args.StandardRestore(template))
+        try:
+            return self._mngr.restore(
+                s, args=ocp.args.StandardRestore(template))
+        except ValueError as e:
+            if "shape" not in str(e).lower():
+                raise
+            # Orbax's shape error suggests enabling truncation — wrong
+            # advice here: a shape mismatch means the checkpoint was
+            # written under a different config or storage layout.
+            raise ValueError(
+                f"checkpoint at {self.directory} step {s} does not match "
+                "this config's shapes: it was written under a different "
+                "config (vocabulary_size / factor_num / model_type) or an "
+                "older storage layout. Retrain, or point model_file at "
+                f"the matching checkpoint. Underlying error: {e}") from e
 
     def close(self) -> None:
         self._mngr.close()

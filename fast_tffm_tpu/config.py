@@ -121,6 +121,17 @@ class FmConfig:
     def num_rows(self) -> int:
         return self.vocabulary_size + 1
 
+    @property
+    def ckpt_rows(self) -> int:
+        """Table rows as stored in checkpoints and on any mesh: num_rows
+        rounded up to a multiple of 4096. The fixed multiple makes the
+        stored shape divisible by every power-of-two device mesh (TPU
+        slices are powers of two; make_mesh enforces it), so checkpoints
+        restore row-sharded on ANY topology without ever assembling the
+        table on one host — jax shardings require evenly divisible dims.
+        The pad rows sit past pad_id: no feature id can reach them."""
+        return -(-self.num_rows // 4096) * 4096
+
 
 _GENERAL_KEYS = {
     "vocabulary_size": int,

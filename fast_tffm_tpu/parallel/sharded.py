@@ -59,6 +59,12 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     n = len(devices)
     if model_axis <= 0 or n % model_axis:
         raise ValueError(f"model_axis {model_axis} must divide {n} devices")
+    # Power-of-two total so the 4096-aligned checkpoint row layout
+    # (FmConfig.ckpt_rows) shards evenly; TPU slices are powers of two.
+    if n & (n - 1) or n > 4096:
+        raise ValueError(
+            f"device count {n} must be a power of two <= 4096 so the "
+            "4096-aligned table rows (FmConfig.ckpt_rows) shard evenly")
     n_data = n // model_axis
     # The pipeline's unique-id buckets are powers of two (>= 64), so the
     # data axis must be a power of two <= 64 for the U axis to shard
@@ -150,12 +156,16 @@ def make_sharded_score_fn(spec: ModelSpec, mesh: Mesh,
 
 
 def padded_num_rows(cfg: FmConfig, mesh: Mesh) -> int:
-    """Table rows rounded up to a multiple of the mesh device count
-    (explicit shardings need divisible dims). The extra rows sit past
-    ``pad_id`` so no id can ever gather or update them; exports slice
-    them off via ``export_npz(..., vocabulary_size=...)``."""
+    """Table rows on the mesh == the checkpoint row layout
+    (``cfg.ckpt_rows``, a fixed 4096 multiple): one shape for runtime,
+    save, and restore means checkpoints round-trip row-sharded on any
+    topology. The extra rows sit past ``pad_id`` so no id can ever
+    gather or update them; exports slice them off via
+    ``export_npz(..., vocabulary_size=...)``."""
     n = int(mesh.devices.size)
-    return -(-cfg.num_rows // n) * n
+    rows = cfg.ckpt_rows
+    assert rows % n == 0, (rows, n)  # make_mesh enforces pow2 <= 4096
+    return rows
 
 
 def init_sharded_state(cfg: FmConfig, mesh: Mesh, seed: int = 0
@@ -184,26 +194,22 @@ def init_sharded_state(cfg: FmConfig, mesh: Mesh, seed: int = 0
     return jax.jit(init, out_shardings=(row, row))(jax.random.PRNGKey(seed))
 
 
-def place_logical_state(cfg: FmConfig, mesh: Mesh, table, acc
-                        ) -> Tuple[jax.Array, jax.Array]:
-    """Lift a logical [num_rows, D] (table, acc) — e.g. restored from a
-    checkpoint written by any topology — onto the mesh, appending the
-    divisibility pad rows (zeros for the table, adagrad_init for the
-    accumulator, both dead by construction)."""
+def place_table(cfg: FmConfig, mesh: Mesh, table) -> jax.Array:
+    """Lift a host/logical table onto the mesh row-sharded, appending
+    the dead pad tail up to the [ckpt_rows, D] runtime layout. The
+    restore path doesn't need this (checkpoints restore sharded
+    directly); it serves callers holding a dense table (tests, external
+    .npz imports)."""
     row = NamedSharding(mesh, ROW_SPEC)
-    n_pad = padded_num_rows(cfg, mesh) - cfg.num_rows
+    n_pad = padded_num_rows(cfg, mesh) - int(np.shape(table)[0])
 
-    def lift(t, a):
-        t = jnp.concatenate(
-            [t, jnp.zeros((n_pad, cfg.row_dim), jnp.float32)], axis=0)
-        a = jnp.concatenate(
-            [a, jnp.full((n_pad, cfg.row_dim), cfg.adagrad_init,
-                         jnp.float32)], axis=0)
-        return t, a
+    def lift(t):
+        pad = jnp.zeros((n_pad, cfg.row_dim), jnp.float32)
+        return jnp.concatenate([t.astype(jnp.float32), pad], axis=0)
 
-    return jax.jit(lift, out_shardings=(row, row))(
-        jnp.asarray(np.asarray(table), jnp.float32),
-        jnp.asarray(np.asarray(acc), jnp.float32))
+    if not isinstance(table, jax.Array):
+        table = jnp.asarray(np.asarray(table), jnp.float32)
+    return jax.jit(lift, out_shardings=row)(table)
 
 
 def global_batch(mesh: Mesh, local_uniq_size: int, **arrays) -> dict:

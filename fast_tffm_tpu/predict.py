@@ -24,24 +24,43 @@ from fast_tffm_tpu.models.fm import ModelSpec, batch_args, make_score_fn
 from fast_tffm_tpu.utils.logging import get_logger
 
 
-def load_table(cfg: FmConfig) -> jax.Array:
+def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
+    """Restore the table from the latest checkpoint.
+
+    With a mesh: restored ROW-SHARDED in the [ckpt_rows, D] checkpoint
+    layout — the full table never materializes on one device or host
+    (BASELINE config #5 scale: 10^9 rows ~ 36 GB dense). Without: the
+    logical [num_rows, D] table on the default device."""
     import jax.numpy as jnp
     from fast_tffm_tpu.train import checkpoint_template
     ckpt = CheckpointState(cfg.model_file)
-    restored = ckpt.restore(template=checkpoint_template(cfg))
+    restored = ckpt.restore(template=checkpoint_template(cfg, mesh))
     ckpt.close()
     if restored is None:
         raise FileNotFoundError(
             f"no checkpoint found under {cfg.model_file}.ckpt "
             "(run training first)")
-    return jnp.asarray(np.asarray(restored["table"]), dtype=jnp.float32)
+    from fast_tffm_tpu.train import check_restored_vocab
+    check_restored_vocab(cfg, restored)
+    if mesh is not None:
+        return restored["table"]
+    # Checkpoints store the 4096-aligned [ckpt_rows, D] layout; the
+    # single-device scorer wants the logical table.
+    return jnp.asarray(restored["table"][:cfg.num_rows], dtype=jnp.float32)
 
 
-def predict_scores(cfg: FmConfig, table: jax.Array,
-                   files) -> np.ndarray:
-    """Raw scores for every example in ``files``, in input order."""
+def predict_scores(cfg: FmConfig, table: jax.Array, files,
+                   mesh=None) -> np.ndarray:
+    """Raw scores for every example in ``files``, in input order. With a
+    mesh, the batch is data-sharded and scored against the row-sharded
+    table in place (table shape [ckpt_rows, D])."""
     spec = ModelSpec.from_config(cfg)
-    score_fn = make_score_fn(spec)
+    if mesh is not None:
+        from fast_tffm_tpu.parallel.sharded import (make_sharded_score_fn,
+                                                    shard_batch)
+        score_fn = make_sharded_score_fn(spec, mesh)
+    else:
+        score_fn = make_score_fn(spec)
     out: List[np.ndarray] = []
     # keep_empty: blank input lines become zero-feature examples so the
     # score file stays line-aligned with the input (SURVEY §3.4).
@@ -49,6 +68,8 @@ def predict_scores(cfg: FmConfig, table: jax.Array,
                                          epochs=1, keep_empty=True)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
+        if mesh is not None:
+            args = shard_batch(mesh, **args)
         scores = np.asarray(score_fn(table, **args))
         out.append(scores[:batch.num_real])
     return (np.concatenate(out) if out
@@ -56,14 +77,40 @@ def predict_scores(cfg: FmConfig, table: jax.Array,
 
 
 def predict(cfg: FmConfig, table: Optional[jax.Array] = None) -> List[str]:
-    """Run batch prediction; returns the list of score files written."""
+    """Run batch prediction; returns the list of score files written.
+
+    Multi-device hosts score through the mesh (row-sharded table +
+    data-sharded batches — SURVEY.md §3.4's single restore+score stack,
+    scaled the same way training is); a lone device gets the plain
+    jitted scorer."""
     logger = get_logger(log_file=cfg.log_file or None)
+    mesh = None
+    if jax.device_count() > 1:
+        from fast_tffm_tpu.parallel.sharded import make_mesh, place_table
+        try:
+            mesh = make_mesh()
+        except ValueError as e:
+            # e.g. a non-power-of-two device count: score on one device
+            # rather than refusing (the table must then fit it).
+            logger.warning("mesh predict unavailable (%s); scoring on a "
+                           "single device", e)
+        if mesh is not None and cfg.batch_size % mesh.shape["data"]:
+            logger.warning(
+                "batch_size %d not divisible by the mesh data axis %d; "
+                "scoring on a single device", cfg.batch_size,
+                mesh.shape["data"])
+            mesh = None
+        if mesh is not None:
+            logger.info("mesh predict: %s over %d devices",
+                        dict(mesh.shape), jax.device_count())
+            if table is not None and int(table.shape[0]) != cfg.ckpt_rows:
+                table = place_table(cfg, mesh, table)
     if table is None:
-        table = load_table(cfg)
+        table = load_table(cfg, mesh)
     os.makedirs(cfg.score_path, exist_ok=True)
     written = []
     for path in expand_files(cfg.predict_files):
-        raw = predict_scores(cfg, table, [path])
+        raw = predict_scores(cfg, table, [path], mesh=mesh)
         vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
         out_path = os.path.join(cfg.score_path,
                                 os.path.basename(path) + ".score")

@@ -58,6 +58,61 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     return auc.result(), n
 
 
+def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
+                         shard_index: int, num_shards: int
+                         ) -> Tuple[float, int]:
+    """Multi-process sharded AUC: every process scores its own input
+    shard through the mesh score fn in lockstep (each call is a
+    collective program), then the per-process binned-AUC histograms are
+    allgathered and merged — no table or score set ever materializes on
+    one host. Returns the same (auc, n_examples) on every process."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from fast_tffm_tpu.data.pipeline import empty_batch
+    from fast_tffm_tpu.parallel.sharded import (global_batch,
+                                                make_sharded_score_fn)
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_sharded_score_fn(spec, mesh)
+    auc = StreamingAUC()
+    n = 0
+    it = batch_iterator(cfg, files, training=False, epochs=1,
+                        shard_index=shard_index, num_shards=num_shards,
+                        fixed_shape=True)
+    while True:
+        batch = next(it, None)
+        flags = multihost_utils.process_allgather(
+            np.asarray([batch is None]))
+        if bool(flags.all()):
+            break
+        if batch is None:
+            batch = empty_batch(cfg)
+        args = batch_args(batch)
+        args.pop("labels"), args.pop("weights")
+        gargs = global_batch(mesh, len(batch.uniq_ids), **args)
+        scores = score_fn(table, **gargs)
+        # This process's rows of the global [B_global] score vector are
+        # exactly its local batch (global_batch concatenates local
+        # batches in process order over process-contiguous data-axis
+        # devices); reassemble them in index order.
+        shards = sorted(scores.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        local = np.concatenate([np.asarray(s.data) for s in shards])
+        assert len(local) == len(batch.labels), (
+            f"local score slice {len(local)} != local batch "
+            f"{len(batch.labels)}")
+        auc.update(local[:batch.num_real], batch.labels[:batch.num_real])
+        n += batch.num_real
+    hists = multihost_utils.process_allgather(
+        np.stack([auc.pos, auc.neg]))          # [P, 2, bins]
+    hists = hists.reshape(-1, 2, auc.num_bins)
+    merged = StreamingAUC(num_bins=auc.num_bins)
+    merged.pos[:] = hists[:, 0, :].sum(axis=0)
+    merged.neg[:] = hists[:, 1, :].sum(axis=0)
+    n_total = int(multihost_utils.process_allgather(
+        np.asarray([n])).sum())
+    return merged.result(), n_total
+
+
 def train(cfg: FmConfig, job_name: Optional[str] = None,
           task_index: Optional[int] = None) -> jax.Array:
     """Run training per config; returns the final table (host-fetchable).
@@ -83,7 +138,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         # the plain jitted step, no mesh machinery.
         from fast_tffm_tpu.parallel.sharded import (
             global_batch, init_sharded_state, make_mesh,
-            make_sharded_train_step, place_logical_state, shard_batch)
+            make_sharded_train_step, shard_batch)
         mesh = make_mesh()
         logger.info("mesh training: %s over %d devices, %d processes",
                     dict(mesh.shape), jax.device_count(),
@@ -103,23 +158,26 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
 
     ckpt = CheckpointState(cfg.model_file)
     global_step = 0
-    restored = ckpt.restore(template=checkpoint_template(cfg))
+    restored = ckpt.restore(template=checkpoint_template(cfg, mesh))
     if restored is not None:
+        check_restored_vocab(cfg, restored)
         global_step = int(restored["step"])
         logger.info("restored checkpoint at step %d", global_step)
     if mesh is not None:
         if restored is not None:
-            table, acc = place_logical_state(cfg, mesh, restored["table"],
-                                             restored["acc"])
+            # The sharded template already placed these row-sharded on
+            # this mesh in the runtime [ckpt_rows, D] layout — use as-is.
+            table, acc = restored["table"], restored["acc"]
         else:
             table, acc = init_sharded_state(cfg, mesh, cfg.seed)
         step_fn = make_sharded_train_step(spec, mesh)
     else:
-        table = init_table(cfg, cfg.seed)
-        acc = init_accumulator(cfg)
         if restored is not None:
-            table = jax.device_put(jnp_like(restored["table"], table))
-            acc = jax.device_put(jnp_like(restored["acc"], acc))
+            table = restored["table"][:cfg.num_rows]
+            acc = restored["acc"][:cfg.num_rows]
+        else:
+            table = init_table(cfg, cfg.seed)
+            acc = init_accumulator(cfg)
         step_fn = make_train_step(spec)
 
     # Preemption handling (SURVEY §5 "Failure detection": the reference
@@ -162,6 +220,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     loss = None
     loss_val = float("nan")
     stopping = False
+    last_val = None  # (auc, n) of the most recent validation pass
     # Handlers stay installed (absorbing re-signals) until the finally
     # below — i.e. until the final checkpoint/export is safely on disk,
     # the window a second SIGTERM is most likely to arrive in. The
@@ -216,6 +275,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 with trace_span("train_step"):
                     table, acc, loss, _ = step_fn(table, acc, **args)
                 global_step += 1
+                last_val = None  # table advanced; any cached AUC is stale
                 timer.tick(batch.num_real * (jax.process_count()
                                              if multi_process else 1))
                 profile_tick(global_step)
@@ -226,16 +286,27 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         global_step, epoch, loss_val,
                         timer.examples_per_sec)
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
-                    ckpt.save(global_step, *logical_state(cfg, table, acc))
-            if cfg.validation_files and not multi_process and not stopping:
-                auc, n = evaluate(cfg, table, cfg.validation_files,
-                                  mesh=mesh)
-                logger.info("epoch %d validation AUC %.6f over %d examples",
-                            epoch, auc, n)
+                    ckpt.save(global_step, *ckpt_state(cfg, table, acc),
+                              vocabulary_size=cfg.vocabulary_size)
+            if cfg.validation_files and not stopping:
+                if multi_process:
+                    auc, n = evaluate_distributed(
+                        cfg, table, cfg.validation_files, mesh,
+                        shard_index, num_shards)
+                else:
+                    auc, n = evaluate(cfg, table, cfg.validation_files,
+                                      mesh=mesh)
+                last_val = (auc, n)
+                if jax.process_index() == 0:
+                    logger.info(
+                        "epoch %d validation AUC %.6f over %d examples",
+                        epoch, auc, n)
         loss_val = float(loss) if loss is not None else loss_val
-        ckpt.save(global_step, *logical_state(cfg, table, acc), force=True)
+        ckpt.save(global_step, *ckpt_state(cfg, table, acc),
+                  vocabulary_size=cfg.vocabulary_size, force=True)
         if multi_process:
-            _chief_finalize(cfg, table, logger)
+            _chief_finalize(cfg, table, logger, mesh, shard_index,
+                            num_shards, last_val)
         else:
             export_npz(table, cfg.model_file + ".npz",
                        vocabulary_size=cfg.vocabulary_size)
@@ -260,45 +331,108 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     return table
 
 
-def _chief_finalize(cfg: FmConfig, table: jax.Array, logger) -> None:
-    """Multi-process epilogue: allgather the logical table to hosts (a
-    collective — every process participates), then the chief alone runs
-    validation AUC and writes the dense .npz export with a plain
-    single-device score fn."""
-    import jax.numpy as jnp
+# Above this, the dense .npz convenience export is skipped (the real
+# model lives in the sharded checkpoint): a 10^9-row table is ~36 GB
+# dense — materializing it on one host is exactly what the sharded
+# design exists to avoid.
+EXPORT_NPZ_MAX_BYTES = 2 << 30
+
+
+def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
+                    shard_index: int, num_shards: int,
+                    last_val=None) -> None:
+    """Multi-process epilogue: final validation AUC via the sharded
+    score fn (table stays row-sharded; only binned histograms cross
+    hosts), then a size-gated dense export assembled chunk-by-chunk so
+    no host ever holds more than the chief's final copy.
+
+    ``last_val`` is the last per-epoch (auc, n): when the final epoch
+    already validated this exact table, re-sweeping validation_files
+    (every batch a collective) would just recompute it."""
     from jax.experimental import multihost_utils
-    # tiled=True: the sharded table's pieces are concatenated (not
-    # stacked) back into the logical [num_rows, D] array on every host.
-    host_table = multihost_utils.process_allgather(table[:cfg.num_rows],
-                                                   tiled=True)
-    if jax.process_index() == 0:
-        export_npz(host_table, cfg.model_file + ".npz",
-                   vocabulary_size=cfg.vocabulary_size)
-        if cfg.validation_files:
-            local = jnp.asarray(np.asarray(host_table), jnp.float32)
-            auc, n = evaluate(cfg, local, cfg.validation_files)
+    if cfg.validation_files:
+        if last_val is None:  # e.g. preemption cut the epoch short
+            last_val = evaluate_distributed(
+                cfg, table, cfg.validation_files, mesh, shard_index,
+                num_shards)
+        if jax.process_index() == 0:
             logger.info("final validation AUC %.6f over %d examples",
-                        auc, n)
+                        *last_val)
+    nbytes = cfg.num_rows * cfg.row_dim * 4
+    if nbytes > EXPORT_NPZ_MAX_BYTES:
+        if jax.process_index() == 0:
+            logger.info(
+                "skipping dense .npz export: table is %.1f GB > %.1f GB "
+                "threshold; use the sharded checkpoint at %s.ckpt",
+                nbytes / 2**30, EXPORT_NPZ_MAX_BYTES / 2**30,
+                cfg.model_file)
+    else:
+        # Chunked allgather: every process participates (collective),
+        # non-chief hosts drop each chunk immediately, so peak extra
+        # host memory is one chunk — not the whole table — everywhere
+        # but the chief, which writes chunks straight into the one
+        # preallocated dense buffer the .npz needs anyway.
+        chunk = max(1, (64 << 20) // (cfg.row_dim * 4))
+        chief = jax.process_index() == 0
+        out = (np.empty((cfg.num_rows, cfg.row_dim), np.float32)
+               if chief else None)
+        for a in range(0, cfg.num_rows, chunk):
+            b = min(a + chunk, cfg.num_rows)
+            piece = multihost_utils.process_allgather(table[a:b],
+                                                      tiled=True)
+            if chief:
+                out[a:b] = np.asarray(piece)
+        if chief:
+            export_npz(out, cfg.model_file + ".npz",
+                       vocabulary_size=cfg.vocabulary_size)
     multihost_utils.sync_global_devices("fast_tffm_tpu_finalize")
 
 
-def logical_state(cfg: FmConfig, table: jax.Array, acc: jax.Array):
-    """Checkpoint contract: always store the logical [num_rows, D]
-    arrays, so checkpoints are portable across topologies (mesh runs
-    re-derive their divisibility pad rows on restore via
-    place_logical_state; single-device runs match directly)."""
-    return table[:cfg.num_rows], acc[:cfg.num_rows]
-
-
-def jnp_like(host_arr, like: jax.Array):
+def ckpt_state(cfg: FmConfig, table: jax.Array, acc: jax.Array):
+    """Checkpoint contract: always store [ckpt_rows, D] — the fixed
+    4096-aligned row layout (FmConfig.ckpt_rows) every topology shares,
+    so a checkpoint saved by any mesh restores row-sharded on any other
+    without assembling the table on one host. Mesh tables are already
+    this shape (orbax saves them sharded — each host writes only its
+    rows); single-device tables get the dead pad tail appended."""
+    n_pad = cfg.ckpt_rows - int(table.shape[0])
+    if n_pad == 0:
+        return table, acc
     import jax.numpy as jnp
-    return jnp.asarray(np.asarray(host_arr), dtype=like.dtype)
+    pad_t = jnp.zeros((n_pad, cfg.row_dim), jnp.float32)
+    pad_a = jnp.full((n_pad, cfg.row_dim), cfg.adagrad_init, jnp.float32)
+    return (jnp.concatenate([table, pad_t], axis=0),
+            jnp.concatenate([acc, pad_a], axis=0))
 
 
-def checkpoint_template(cfg: FmConfig):
+def checkpoint_template(cfg: FmConfig, mesh=None):
     """Abstract pytree matching CheckpointState.save's layout — orbax
-    needs it to restore from a process that didn't do the saving."""
-    shape = (cfg.num_rows, cfg.row_dim)
-    return {"table": jax.ShapeDtypeStruct(shape, np.float32),
-            "acc": jax.ShapeDtypeStruct(shape, np.float32),
-            "step": 0}
+    needs it to restore from a process that didn't do the saving.
+
+    The explicit sharding makes restore topology-portable: orbax places
+    the arrays per THIS run's layout instead of repopulating whatever
+    sharding the saving topology recorded (which, for a multi-host save
+    restored elsewhere, would yield non-addressable arrays)."""
+    shape = (cfg.ckpt_rows, cfg.row_dim)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from fast_tffm_tpu.parallel.sharded import ROW_SPEC
+        sh = NamedSharding(mesh, ROW_SPEC)
+    else:
+        sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    return {"table": jax.ShapeDtypeStruct(shape, np.float32, sharding=sh),
+            "acc": jax.ShapeDtypeStruct(shape, np.float32, sharding=sh),
+            "step": 0, "vocab": 0}
+
+
+def check_restored_vocab(cfg: FmConfig, restored) -> None:
+    """The 4096-aligned storage shape can't distinguish vocabularies in
+    the same bucket, so the stored vocab is verified explicitly — a
+    mismatch would silently turn a trained row into the pad row."""
+    v = int(restored["vocab"])
+    if v != cfg.vocabulary_size:
+        raise ValueError(
+            f"checkpoint was written with vocabulary_size={v}, but this "
+            f"config has vocabulary_size={cfg.vocabulary_size}; restoring "
+            "would misalign the pad row and feature ids. Retrain, or fix "
+            "the config.")

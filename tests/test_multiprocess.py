@@ -23,8 +23,52 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _write_cfg(cfg_path, data, model, epoch_num):
+    # coordinator_address() uses worker port + 1000; pick a free one
+    # per launch (rebinding the previous port risks TIME_WAIT).
+    coord = _free_port()
+    cfg_path.write_text(f"""
+[General]
+vocabulary_size = 128
+factor_num = 4
+model_file = {model}
+
+[Train]
+train_files = {data}
+validation_files = {data}
+epoch_num = {epoch_num}
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+log_steps = 4
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+
+
+def _launch(cfg_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "run_tffm.py", "train", str(cfg_path),
+             "dist_train", "worker", str(i)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    return outs
+
+
 @pytest.mark.slow
-def test_two_worker_dist_train(tmp_path):
+def test_two_worker_dist_train_and_resume(tmp_path):
     rng = np.random.default_rng(0)
     # 193 lines over 2 workers with batch_size 32: shards of 97/96 lines
     # -> 4 vs 3 batches. The lockstep filler-batch protocol must absorb
@@ -38,51 +82,29 @@ def test_two_worker_dist_train(tmp_path):
     data = tmp_path / "train.txt"
     data.write_text("\n".join(lines) + "\n")
 
-    # coordinator_address() uses worker port + 1000; pick a free one.
-    coord = _free_port()
     model = tmp_path / "model" / "fm"
     cfg = tmp_path / "dist.cfg"
-    cfg.write_text(f"""
-[General]
-vocabulary_size = 128
-factor_num = 4
-model_file = {model}
-
-[Train]
-train_files = {data}
-validation_files = {data}
-epoch_num = 2
-batch_size = 32
-learning_rate = 0.1
-shuffle = False
-log_steps = 4
-
-[Cluster]
-worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
-""")
-
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "run_tffm.py", "train", str(cfg),
-             "dist_train", "worker", str(i)],
-            cwd=REPO, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True)
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    _write_cfg(cfg, data, model, epoch_num=2)
+    outs = _launch(cfg)
     assert any("mesh training" in o for o in outs)
     assert any("training done" in o for o in outs)
-    # Chief epilogue: final AUC over the (separable-ish) train set and
-    # the dense export, exactly once.
+    # Per-epoch sharded validation runs inside multi-process training
+    # (chief logs it each epoch), plus the chief epilogue's final AUC.
+    assert sum("epoch 0 validation AUC" in o for o in outs) == 1
+    assert sum("epoch 1 validation AUC" in o for o in outs) == 1
     assert sum("final validation AUC" in o for o in outs) == 1
     assert os.path.exists(str(model) + ".npz")
     # Shared checkpoint written once, restorable by a single process.
     ckpt_dir = str(model) + ".ckpt"
     assert os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir)
+
+    # Resume: a second 2-process job over the same model_file must
+    # restore the multi-host checkpoint via the sharded template (the
+    # unsharded-template path fails on non-addressable arrays) and
+    # continue to the larger epoch budget.
+    _write_cfg(cfg, data, model, epoch_num=3)
+    outs2 = _launch(cfg)
+    assert all("restored checkpoint at step" in o for o in outs2), (
+        outs2[0][-2000:])
+    assert any("training done" in o for o in outs2)
+    assert sum("epoch 2 validation AUC" in o for o in outs2) == 1

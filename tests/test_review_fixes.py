@@ -111,6 +111,50 @@ def test_ignored_reference_knobs_warn(tmp_path):
             load_config(str(p))
 
 
+def test_checkpoint_shape_mismatch_is_actionable(tmp_path):
+    # A checkpoint written under one config restored under another must
+    # fail with a message naming the shapes and the fix, not orbax's
+    # internal shape error.
+    from fast_tffm_tpu.checkpoint import CheckpointState
+    from fast_tffm_tpu.models.fm import init_accumulator, init_table
+    from fast_tffm_tpu.train import checkpoint_template, ckpt_state
+    model = str(tmp_path / "m" / "fm")
+    cfg = FmConfig(vocabulary_size=64, factor_num=4, model_file=model)
+    ckpt = CheckpointState(model)
+    ckpt.save(1, *ckpt_state(cfg, init_table(cfg), init_accumulator(cfg)),
+              vocabulary_size=cfg.vocabulary_size, force=True)
+    ckpt.close()
+    cfg2 = FmConfig(vocabulary_size=64, factor_num=8, model_file=model)
+    ckpt2 = CheckpointState(model)
+    with pytest.raises(ValueError, match="different config"):
+        ckpt2.restore(template=checkpoint_template(cfg2))
+    ckpt2.close()
+
+
+def test_checkpoint_vocab_change_same_bucket_rejected(tmp_path):
+    # vocabulary_size changes within the same 4096-row storage bucket
+    # keep the stored shape identical, so the shape check can't fire;
+    # the stored vocab leaf must catch it (a silent restore would turn
+    # a trained row into the pad row).
+    from fast_tffm_tpu.checkpoint import CheckpointState
+    from fast_tffm_tpu.models.fm import init_accumulator, init_table
+    from fast_tffm_tpu.train import (check_restored_vocab,
+                                     checkpoint_template, ckpt_state)
+    model = str(tmp_path / "m" / "fm")
+    cfg = FmConfig(vocabulary_size=2000, factor_num=4, model_file=model)
+    ckpt = CheckpointState(model)
+    ckpt.save(1, *ckpt_state(cfg, init_table(cfg), init_accumulator(cfg)),
+              vocabulary_size=cfg.vocabulary_size, force=True)
+    ckpt.close()
+    cfg2 = FmConfig(vocabulary_size=1000, factor_num=4, model_file=model)
+    assert cfg2.ckpt_rows == cfg.ckpt_rows  # same storage bucket
+    ckpt2 = CheckpointState(model)
+    restored = ckpt2.restore(template=checkpoint_template(cfg2))
+    ckpt2.close()
+    with pytest.raises(ValueError, match="vocabulary_size=2000"):
+        check_restored_vocab(cfg2, restored)
+
+
 def test_profiler_closed_when_loop_raises(tmp_path):
     # A parse error mid-loop with the profiler window open must still
     # stop the trace (finally), or the next start_trace in this process
