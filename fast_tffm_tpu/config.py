@@ -63,6 +63,10 @@ class FmConfig:
     # Static-shape bucketing (TPU-specific; SURVEY §7 hard part #1):
     max_features_per_example: int = 256   # hard cap on nnz/example (truncate)
     bucket_ladder: Tuple[int, ...] = (8, 16, 32, 64, 128, 256)
+    # Fixed unique-row count per batch in multi-process (fixed-shape)
+    # training. 0 = auto: measured from the data at startup
+    # (data/pipeline.probe_uniq_bucket). Overfull batches spill safely.
+    uniq_bucket: int = 0
     kernel: str = "xla"             # "xla" | "pallas"
     # Profiling (SURVEY §5 "Tracing": reference has none; we dump a
     # TensorBoard/Perfetto trace of a steady-state step window on demand):
@@ -99,6 +103,16 @@ class FmConfig:
             raise ValueError("factor_num must be positive")
         if self.vocabulary_size <= 0:
             raise ValueError("vocabulary_size must be positive")
+        ub = self.uniq_bucket
+        if ub and (ub < 64 or ub & (ub - 1)):
+            raise ValueError(
+                f"uniq_bucket must be 0 (auto) or a power of two >= 64 "
+                f"(mesh sharding divides the unique axis), got {ub}")
+        if ub and self.max_features_per_example >= ub:
+            raise ValueError(
+                f"uniq_bucket ({ub}) must exceed max_features_per_example "
+                f"({self.max_features_per_example}): one example alone "
+                "may otherwise overflow the unique-row budget mid-run")
 
     @property
     def row_dim(self) -> int:
@@ -163,6 +177,7 @@ _TRAIN_KEYS = {
     "save_steps": int,
     "log_steps": int,
     "max_features_per_example": int,
+    "uniq_bucket": int,
     "kernel": str,
     "profile_dir": str,
     "profile_start_step": int,

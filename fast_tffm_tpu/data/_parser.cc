@@ -373,12 +373,14 @@ struct BatchBuilder {
   int64_t B, L, vocab;
   bool hash_ids;
   int max_feats;
+  int64_t max_uniq;  // 0 = unlimited; else batch closes BEFORE exceeding
   std::vector<float> labels;    // [B]
   std::vector<int32_t> uniq;    // [B*L + 1]
   std::vector<int32_t> li;      // [B*L], default 0 (pad slot)
   std::vector<float> vals;      // [B*L], default 0
   std::vector<int32_t> slot;    // dedup table -> slot index
   std::vector<uint32_t> stamp;  // dedup table stamping
+  std::vector<uint32_t> line_slots;  // hash slots inserted by current line
   uint32_t cur_stamp = 0;
   uint32_t mask = 0;
   int64_t n_ex = 0;
@@ -406,6 +408,7 @@ inline int32_t bb_slot(BatchBuilder* bb, int32_t key) {
       bb->stamp[h] = bb->cur_stamp;
       bb->slot[h] = bb->n_uniq;
       bb->uniq[size_t(bb->n_uniq)] = key;
+      bb->line_slots.push_back(h);  // for per-line rollback (uniq cap)
       return bb->n_uniq++;
     }
     if (bb->uniq[size_t(bb->slot[h])] == key) return bb->slot[h];
@@ -413,12 +416,22 @@ inline int32_t bb_slot(BatchBuilder* bb, int32_t key) {
   }
 }
 
+// Undo the current line's unique insertions. Un-stamping (stamp 0 never
+// equals cur_stamp >= 1) is probe-chain-safe: a committed key's probe
+// path to its slot runs over slots that were already occupied at its
+// insertion time, and the rolled-back slots were all claimed later, so
+// they can't sit on any committed path.
+inline void bb_rollback_line(BatchBuilder* bb, int32_t saved_uniq) {
+  for (uint32_t h : bb->line_slots) bb->stamp[h] = 0;
+  bb->n_uniq = saved_uniq;
+}
+
 }  // namespace
 
 extern "C" {
 
 void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
-                int max_feats) {
+                int max_feats, int64_t max_uniq) {
   if (B <= 0 || L <= 0 || vocab <= 0) return nullptr;
   auto* bb = new BatchBuilder();
   bb->B = B;
@@ -426,6 +439,13 @@ void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
   bb->vocab = vocab;
   bb->hash_ids = hash_ids != 0;
   bb->max_feats = (max_feats > 0 && max_feats < L) ? max_feats : int(L);
+  // A single line adds <= max_feats uniques (+ the pad slot), so the cap
+  // must exceed that or one line could never fit in an empty batch.
+  if (max_uniq != 0 && max_uniq <= bb->max_feats) {
+    delete bb;
+    return nullptr;
+  }
+  bb->max_uniq = max_uniq;
   bb->labels.resize(size_t(B));
   bb->uniq.resize(size_t(B * L + 1));
   bb->uniq[0] = int32_t(vocab);  // pad slot
@@ -474,6 +494,8 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
     float* vrow = bb->vals.data() + bb->n_ex * bb->L;
     int32_t* irow = bb->li.data() + bb->n_ex * bb->L;
     int n_feats = 0;
+    bb->line_slots.clear();
+    const int32_t saved_uniq = bb->n_uniq;
     q = tok_end;
     while (true) {
       while (q < line_end && is_ws(*q)) q++;
@@ -532,6 +554,25 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
       vrow[n_feats] = val;
       n_feats++;
       q = tok_end;
+    }
+    if (bb->max_uniq != 0 && bb->n_uniq > bb->max_uniq) {
+      // This line would push the batch past its unique-row budget:
+      // roll it back, close the batch early (spill protocol — the line
+      // is left unconsumed and opens the next batch). fm_bb_new
+      // guarantees a single line always fits an empty batch.
+      bb_rollback_line(bb, saved_uniq);
+      std::memset(irow, 0, size_t(n_feats) * sizeof(int32_t));
+      std::memset(vrow, 0, size_t(n_feats) * sizeof(float));
+      bb->lineno--;  // will be re-fed
+      if (bb->n_ex == 0) {
+        std::snprintf(err_out, size_t(err_cap),
+                      "line %lld: single example exceeds the unique-row "
+                      "budget %lld; raise uniq_bucket",
+                      (long long)(bb->lineno + 1), (long long)bb->max_uniq);
+        return -1;
+      }
+      *consumed_out = p - blob;
+      return 1;
     }
     bb->labels[size_t(bb->n_ex)] = label;
     if (n_feats > bb->max_nnz) bb->max_nnz = n_feats;

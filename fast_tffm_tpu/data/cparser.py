@@ -87,7 +87,8 @@ def _load() -> ctypes.CDLL:
         ]
         lib.fm_bb_new.restype = ctypes.c_void_p
         lib.fm_bb_new.argtypes = [ctypes.c_int64, ctypes.c_int64,
-                                  ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+                                  ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int64]
         lib.fm_bb_free.argtypes = [ctypes.c_void_p]
         lib.fm_bb_feed.restype = ctypes.c_int
         lib.fm_bb_feed.argtypes = [
@@ -160,15 +161,25 @@ class BatchBuilder:
 
     def __init__(self, batch_size: int, max_cols: int,
                  vocabulary_size: int, hash_feature_id: bool = False,
-                 max_features_per_example: int = 0):
+                 max_features_per_example: int = 0, max_uniq: int = 0):
+        """``max_uniq`` > 0 caps the batch's unique-row count (incl. the
+        pad slot): a line that would exceed it closes the batch early
+        (spill) and opens the next one — the fixed-U protocol for
+        multi-process SPMD. Must exceed the per-example feature cap."""
         self._lib = _load()
         self.B, self.L = batch_size, max_cols
         self._h = self._lib.fm_bb_new(batch_size, max_cols,
                                       vocabulary_size,
                                       int(hash_feature_id),
-                                      max_features_per_example)
+                                      max_features_per_example,
+                                      max_uniq)
         if not self._h:
-            raise RuntimeError("fm_bb_new failed (bad sizes)")
+            # ValueError, not RuntimeError: the extension IS available,
+            # the arguments are wrong — callers must not read this as
+            # "no C++, use the slow path" and silently degrade.
+            raise ValueError("fm_bb_new rejected its arguments (bad "
+                             "sizes, or max_uniq <= max feature count "
+                             "per example)")
         self._err = ctypes.create_string_buffer(512)
 
     def feed(self, chunk: bytes, offset: int = 0) -> "tuple[bool, int]":

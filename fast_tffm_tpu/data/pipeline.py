@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import glob as globlib
+import os
 import random
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -31,6 +32,11 @@ import numpy as np
 
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.parser import ParsedBlock
+
+
+class UniqOverflow(ValueError):
+    """A batch's unique-id count exceeds the fixed unique bucket; the
+    caller must spill (emit a prefix of the batch and requeue the rest)."""
 
 
 @dataclasses.dataclass
@@ -94,14 +100,17 @@ def _uniq_ladder(batch_size: int, max_l: int) -> List[int]:
 def make_device_batch(block: ParsedBlock, cfg: FmConfig,
                       weights: Optional[np.ndarray] = None,
                       batch_size: Optional[int] = None,
-                      fixed_shape: bool = False) -> DeviceBatch:
+                      fixed_shape: bool = False,
+                      uniq_bucket: int = 0) -> DeviceBatch:
     """CSR block -> fixed-shape DeviceBatch (pad + host-side unique).
 
-    ``fixed_shape`` pins L and U to their ladder maxima instead of
-    fitting this batch — required in multi-process SPMD, where every
-    process must assemble identically-shaped global arrays every step
-    (a process whose local batch picked a smaller bucket would deadlock
-    the collective program).
+    ``fixed_shape`` pins L and U instead of fitting this batch —
+    required in multi-process SPMD, where every process must assemble
+    identically-shaped global arrays every step (a process whose local
+    batch picked a smaller bucket would deadlock the collective
+    program). ``uniq_bucket`` (fixed_shape only) pins U to a measured
+    density bound instead of the worst-case ladder top — raising
+    UniqOverflow when the block genuinely exceeds it (spill protocol).
     """
     B = batch_size or cfg.batch_size
     n_real = block.batch_size
@@ -123,7 +132,14 @@ def make_device_batch(block: ParsedBlock, cfg: FmConfig,
     except RuntimeError:  # C++ extension unavailable
         uniq, inverse = np.unique(block.ids, return_inverse=True)
     uladder = _uniq_ladder(B, L)
-    U = uladder[-1] if fixed_shape else _ladder_fit(len(uniq) + 1, uladder)
+    if fixed_shape:
+        U = uniq_bucket or uladder[-1]
+        if len(uniq) + 1 > U:
+            raise UniqOverflow(
+                f"{len(uniq)} unique ids exceed the fixed unique bucket "
+                f"{U} (one slot is reserved for padding)")
+    else:
+        U = _ladder_fit(len(uniq) + 1, uladder)
 
     uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
     uniq_ids[:len(uniq)] = uniq
@@ -157,48 +173,125 @@ def make_device_batch(block: ParsedBlock, cfg: FmConfig,
                        num_real=n_real)
 
 
+def shard_byte_range(path: str, shard_index: int,
+                     num_shards: int) -> Tuple[int, int]:
+    """This shard's byte range of ``path``: worker i owns every line
+    whose FIRST byte falls in [size*i/N, size*(i+1)/N). Each worker
+    reads only ~1/N of every file (the reference sharded whole files
+    across workers; byte ranges additionally balance one big file)."""
+    size = os.path.getsize(path)
+    return (size * shard_index // num_shards,
+            size * (shard_index + 1) // num_shards)
+
+
+def _iter_owned_chunks(path: str, start: int, end: int,
+                       chunk_bytes: int = 4 << 20) -> Iterator[bytes]:
+    """Yield byte chunks that together contain exactly the lines owned
+    by byte range [start, end) of ``path``.
+
+    Ownership is by line start (the Hadoop-split convention): the line
+    straddling ``start`` belongs to the previous range (skipped by
+    scanning from start-1 to the first newline — adjacent ranges agree
+    on that newline, so every line is owned exactly once); the line
+    straddling ``end`` is read to completion. Only the final chunk at
+    EOF may lack a trailing newline.
+    """
+    with open(path, "rb") as fh:
+        pos = start
+        if start > 0:
+            fh.seek(start - 1)
+            while True:  # skip to the byte after the first newline
+                b = fh.read(chunk_bytes)
+                if not b:
+                    return  # EOF before any owned line
+                i = b.find(b"\n")
+                if i >= 0:
+                    pos = fh.tell() - len(b) + i + 1
+                    fh.seek(pos)
+                    break
+        if pos >= end:
+            return  # first owned line starts past the range
+        while True:
+            b = fh.read(chunk_bytes)
+            if not b:
+                return
+            if pos + len(b) >= end:
+                # The ownership boundary falls in this chunk: emit
+                # through the first newline at absolute offset >= end-1
+                # (the last owned line's terminator) and stop.
+                cut = b.find(b"\n", max(end - 1 - pos, 0))
+                if cut >= 0:
+                    yield b[:cut + 1]
+                    return
+                # straddling line continues past this chunk: keep going
+            yield b
+            pos += len(b)
+
+
 def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                 shard_index: int, num_shards: int,
                 keep_empty: bool = False) -> Iterator[Tuple[str, float]]:
-    """Yield (line, weight) pairs, sharded by global line index so N
-    data-parallel processes see disjoint examples (the reference shards by
-    giving workers disjoint file lists; index-sharding also balances a
-    single big file)."""
-    wf = list(weight_files) if weight_files else [None] * len(files)
-    if weight_files and len(weight_files) != len(files):
-        raise ValueError("weight_files must parallel train_files "
-                         f"({len(weight_files)} vs {len(files)})")
-    idx = 0
-    for path, wpath in zip(files, wf):
-        wfh = open(wpath) if wpath else None
-        try:
-            with open(path) as fh:
+    """Yield (line, weight) pairs for this shard.
+
+    Default sharding is per-file byte ranges (shard_byte_range): each
+    worker reads only its ~1/N of the bytes. Weight files are
+    line-parallel to data files, so byte-ranging the data would
+    misalign them — with weight_files the iterator falls back to
+    index-modulo sharding over a full read (weight files are a niche
+    reference feature; the fast path never has them)."""
+    if weight_files:
+        if len(weight_files) != len(files):
+            raise ValueError("weight_files must parallel train_files "
+                             f"({len(weight_files)} vs {len(files)})")
+        idx = 0
+        for path, wpath in zip(files, weight_files):
+            with open(path) as fh, open(wpath) as wfh:
                 for line in fh:
-                    wline = wfh.readline() if wfh else ""
+                    wline = wfh.readline()
                     if not line.strip() and not keep_empty:
                         continue
                     if idx % num_shards == shard_index:
                         yield line, float(wline) if wline.strip() else 1.0
                     idx += 1
-        finally:
-            if wfh:
-                wfh.close()
+        return
+    for path in files:
+        start, end = shard_byte_range(path, shard_index, num_shards)
+        tail = b""
+        for chunk in _iter_owned_chunks(path, start, end):
+            data = tail + chunk if tail else chunk
+            parts = data.split(b"\n")
+            tail = parts.pop()
+            for raw in parts:
+                line = raw.decode("utf-8")
+                if line.strip() or keep_empty:
+                    yield line, 1.0
+        if tail:
+            line = tail.decode("utf-8")
+            if line.strip() or keep_empty:
+                yield line, 1.0
 
 
 def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                          n_epochs: int, shuffle: bool,
-                         seed: Optional[int],
-                         fixed_shape: bool) -> Iterator[DeviceBatch]:
+                         seed: Optional[int], fixed_shape: bool,
+                         shard_index: int = 0, num_shards: int = 1,
+                         uniq_bucket: int = 0) -> Iterator[DeviceBatch]:
     """Chunked C++ fast path: raw file bytes stream straight into the
     C++ BatchBuilder (parse + hash + dedup + padded scatter in one native
-    pass); Python never touches individual lines.
+    pass); Python never touches individual lines. Sharded input reads
+    only this worker's byte ranges (shard_byte_range) — N workers read
+    each byte once, not N times.
 
     Shuffle here is a window-of-batches pick plus a within-batch row
     permutation — the same mixing radius as the reference's bounded
     shuffle queue of ``queue_size`` lines (SURVEY §2 "Input pipeline"),
     expressed at batch granularity. Exact reservoir-per-line semantics
-    remain on the generic path (weight files / FFM / sharded input / the
-    Python parser force it).
+    remain on the generic path (weight files / FFM / the Python parser
+    force it).
+
+    With ``uniq_bucket`` (fixed_shape multi-process mode) the builder
+    caps each batch's unique rows; a too-dense batch closes early with
+    n < B real examples (the spill protocol) and shapes stay constant.
     """
     L_cap = bb.L
     pyrng = random.Random(cfg.seed if seed is None else seed)
@@ -212,9 +305,12 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
         if L < L_cap:
             li = np.ascontiguousarray(li[:, :L])
             vals = np.ascontiguousarray(vals[:, :L])
-        uladder = _uniq_ladder(B, L)
-        U = uladder[-1] if fixed_shape else _ladder_fit(len(uniq) + 1,
-                                                        uladder)
+        if fixed_shape and uniq_bucket:
+            U = uniq_bucket  # builder guarantees len(uniq) <= U
+        else:
+            uladder = _uniq_ladder(B, L)
+            U = uladder[-1] if fixed_shape else _ladder_fit(len(uniq) + 1,
+                                                            uladder)
         uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
         uniq_ids[:len(uniq)] = uniq  # slot 0 already pad_id (C++ layout)
         weights = np.zeros(B, np.float32)
@@ -239,29 +335,27 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
         else:
             yield batch
 
+    tail = b""
+
+    def feed_all(data: bytes) -> Iterator[DeviceBatch]:
+        nonlocal tail
+        off = 0
+        while True:
+            full, consumed = bb.feed(data, off)
+            off += consumed
+            if not full:
+                break
+            yield from drain(emit(*bb.finish()))
+        tail = data[off:]  # unconsumed partial line, re-fed next chunk
+
     for _ in range(n_epochs):
         for path in files:
-            with open(path, "rb") as fh:
-                tail = b""
-                while True:
-                    chunk = fh.read(4 << 20)
-                    if not chunk:
-                        if not tail:
-                            break
-                        # final line missing its newline
-                        data, tail = tail + b"\n", b""
-                    else:
-                        data, tail = (tail + chunk if tail else chunk), b""
-                    off = 0
-                    while True:
-                        full, consumed = bb.feed(data, off)
-                        off += consumed
-                        if not full:
-                            break
-                        yield from drain(emit(*bb.finish()))
-                    tail = data[off:]
-                    if not chunk:
-                        break
+            start, end = shard_byte_range(path, shard_index, num_shards)
+            tail = b""
+            for chunk in _iter_owned_chunks(path, start, end):
+                yield from feed_all(tail + chunk if tail else chunk)
+            if tail:  # final owned line missing its newline
+                yield from feed_all(tail + b"\n")
         n, labels, uniq, li, vals, max_nnz = bb.finish()
         if n:  # short final batch of the epoch
             yield from drain(emit(n, labels, uniq, li, vals, max_nnz))
@@ -277,12 +371,17 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    batch_size: Optional[int] = None,
                    seed: Optional[int] = None,
                    keep_empty: bool = False,
-                   fixed_shape: bool = False) -> Iterator[DeviceBatch]:
+                   fixed_shape: bool = False,
+                   uniq_bucket: int = 0) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files.
 
     Shuffling is a bounded reservoir of ``cfg.queue_size`` lines, the same
     memory/coverage contract as the reference's shuffle queue (SURVEY §2
     "Input pipeline"); deterministic given ``seed``.
+
+    ``uniq_bucket`` (fixed_shape mode): fixed unique-row count per batch
+    — see probe_uniq_bucket. Overfull batches spill: they close early
+    with fewer real examples and the remainder opens the next batch.
     """
     from fast_tffm_tpu.data.parser import parse_lines
     from fast_tffm_tpu.data.cparser import parse_lines_fast
@@ -293,12 +392,14 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                                                   else 1)
     rng = random.Random(cfg.seed if seed is None else seed)
     do_shuffle = training and cfg.shuffle
+    uniq_bucket = uniq_bucket or cfg.uniq_bucket
 
     # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
-    # no feature needs per-line Python handling. Requires a hard
-    # per-example cap (the builder writes fixed-stride rows);
-    # max_features_per_example = 0 means "unlimited" and stays generic.
-    if (num_shards == 1 and not keep_empty and not weight_files
+    # no feature needs per-line Python handling — including sharded
+    # multi-process input (byte ranges). Requires a hard per-example cap
+    # (the builder writes fixed-stride rows); max_features_per_example =
+    # 0 means "unlimited" and stays generic.
+    if (not keep_empty and not weight_files
             and cfg.model_type != "ffm"
             and cfg.max_features_per_example > 0):
         try:
@@ -312,12 +413,15 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             bb = BatchBuilder(B, L_cap, cfg.vocabulary_size,
                               hash_feature_id=cfg.hash_feature_id,
                               max_features_per_example=(
-                                  cfg.max_features_per_example))
+                                  cfg.max_features_per_example),
+                              max_uniq=(uniq_bucket if fixed_shape else 0))
         except RuntimeError:
             bb = None  # C++ extension unavailable -> generic path
         if bb is not None:
             yield from _fast_batch_iterator(cfg, bb, files, B, n_epochs,
-                                            do_shuffle, seed, fixed_shape)
+                                            do_shuffle, seed, fixed_shape,
+                                            shard_index, num_shards,
+                                            uniq_bucket)
             return
     # keep_empty needs blank lines to become zero-feature examples; only
     # the Python parser implements that.
@@ -335,8 +439,27 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                 lines = [c[0] for c in chunk]
                 w = np.array([c[1] for c in chunk], dtype=np.float32)
                 block = _parse_block(lines, cfg, parse, keep_empty)
-                yield make_device_batch(block, cfg, weights=w, batch_size=B,
-                                        fixed_shape=fixed_shape)
+                try:
+                    yield make_device_batch(block, cfg, weights=w,
+                                            batch_size=B,
+                                            fixed_shape=fixed_shape,
+                                            uniq_bucket=uniq_bucket)
+                except UniqOverflow:
+                    # Spill: emit the longest example prefix that fits
+                    # the unique budget; the tail reopens the queue.
+                    m = _uniq_prefix_examples(block, uniq_bucket)
+                    if m == 0:
+                        raise ValueError(
+                            "single example exceeds uniq_bucket "
+                            f"{uniq_bucket}; raise it (or set 0 for "
+                            "auto)")
+                    pending[0:0] = chunk[m:]
+                    head = _parse_block([c[0] for c in chunk[:m]], cfg,
+                                        parse, keep_empty)
+                    yield make_device_batch(head, cfg, weights=w[:m],
+                                            batch_size=B,
+                                            fixed_shape=fixed_shape,
+                                            uniq_bucket=uniq_bucket)
 
         for item in _iter_lines(files, weight_files if training else (),
                                 shard_index, num_shards,
@@ -356,19 +479,71 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
         yield from flush_batches(True)
 
 
-def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None
-                ) -> DeviceBatch:
+def _uniq_prefix_examples(block: ParsedBlock, uniq_bucket: int) -> int:
+    """Largest count of leading examples whose id union fits the unique
+    bucket (one slot reserved for padding) — the generic-path spill
+    split point."""
+    if block.batch_size == 0:
+        return 0
+    _, first_pos = np.unique(block.ids, return_index=True)
+    # Example index owning each first occurrence -> uniques per example.
+    ex = np.searchsorted(block.poses, first_pos, side="right") - 1
+    cum = np.cumsum(np.bincount(ex, minlength=block.batch_size))
+    return int(np.searchsorted(cum, uniq_bucket - 1, side="right"))
+
+
+def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
+                      batch_size: Optional[int] = None) -> int:
+    """Pick the fixed unique-row bucket for multi-process training by
+    measuring the data instead of assuming the worst case (the ladder
+    top is next_pow2(B*L) — ~50x a realistic Criteo batch's uniques).
+
+    Parses the first batch of the FIRST file — every process reads the
+    same bytes, so all agree without a collective — and returns the next
+    power of two >= 2x the measured unique count (>= 64, > the
+    per-example cap, <= the ladder top). Densities the probe missed are
+    absorbed by the spill protocol, costing throughput, never
+    correctness.
+    """
+    B = batch_size or cfg.batch_size
+    files = expand_files(files)
+    lines: List[str] = []
+    with open(files[0]) as fh:
+        for line in fh:
+            if line.strip():
+                lines.append(line)
+            if len(lines) >= B:
+                break
+    L_cap = _ladder_fit(
+        max(cfg.bucket_ladder[-1], cfg.max_features_per_example),
+        cfg.bucket_ladder)
+    top = _uniq_ladder(B, L_cap)[-1]
+    if not lines:
+        return min(1 << 10, top)
+    from fast_tffm_tpu.data.cparser import parse_lines_fast
+    parse = None if cfg.model_type == "ffm" else parse_lines_fast
+    block = _parse_block(lines, cfg, parse)
+    u = len(np.unique(block.ids))
+    b = 64
+    while b < 2 * (u + 2) or b <= cfg.max_features_per_example:
+        b *= 2
+    return min(b, top)
+
+
+def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None,
+                uniq_bucket: int = 0) -> DeviceBatch:
     """An all-padding batch (num_real=0, zero weights): the SPMD filler a
     data-exhausted process feeds while peers finish their shards — every
     term it contributes to loss/grad/reg is exactly zero by the padding
-    invariants above."""
+    invariants above. ``uniq_bucket`` must match the live batches'."""
     fields = (np.zeros(0, np.int32) if cfg.model_type == "ffm" else None)
     block = ParsedBlock(labels=np.zeros(0, np.float32),
                         poses=np.zeros(1, np.int32),
                         ids=np.zeros(0, np.int32),
                         vals=np.zeros(0, np.float32), fields=fields)
     return make_device_batch(block, cfg, batch_size=batch_size,
-                             fixed_shape=True)
+                             fixed_shape=True,
+                             uniq_bucket=uniq_bucket or cfg.uniq_bucket)
 
 
 def prefetch(iterator: Iterator[DeviceBatch],

@@ -59,25 +59,31 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
 
 
 def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
-                         shard_index: int, num_shards: int
-                         ) -> Tuple[float, int]:
+                         shard_index: int, num_shards: int,
+                         uniq_bucket: int = 0) -> Tuple[float, int]:
     """Multi-process sharded AUC: every process scores its own input
     shard through the mesh score fn in lockstep (each call is a
     collective program), then the per-process binned-AUC histograms are
     allgathered and merged — no table or score set ever materializes on
-    one host. Returns the same (auc, n_examples) on every process."""
+    one host. Returns the same (auc, n_examples) on every process.
+
+    ``uniq_bucket``: pass the caller's once-probed value; 0 re-probes
+    (deterministic — same bytes on every process, so all agree without
+    a collective)."""
     import numpy as np
     from jax.experimental import multihost_utils
-    from fast_tffm_tpu.data.pipeline import empty_batch
+    from fast_tffm_tpu.data.pipeline import (empty_batch,
+                                             probe_uniq_bucket)
     from fast_tffm_tpu.parallel.sharded import (global_batch,
                                                 make_sharded_score_fn)
     spec = ModelSpec.from_config(cfg)
     score_fn = make_sharded_score_fn(spec, mesh)
     auc = StreamingAUC()
     n = 0
+    ub = uniq_bucket or cfg.uniq_bucket or probe_uniq_bucket(cfg, files)
     it = batch_iterator(cfg, files, training=False, epochs=1,
                         shard_index=shard_index, num_shards=num_shards,
-                        fixed_shape=True)
+                        fixed_shape=True, uniq_bucket=ub)
     while True:
         batch = next(it, None)
         flags = multihost_utils.process_allgather(
@@ -85,7 +91,7 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
         if bool(flags.all()):
             break
         if batch is None:
-            batch = empty_batch(cfg)
+            batch = empty_batch(cfg, uniq_bucket=ub)
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         gargs = global_batch(mesh, len(batch.uniq_ids), **args)
@@ -155,6 +161,22 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             f"({cfg.max_features_per_example}) <= bucket_ladder max "
             f"({cfg.bucket_ladder[-1]}) so over-long examples are "
             "truncated up front instead of faulting one worker mid-run")
+
+    uniq_bucket = 0
+    if multi_process:
+        # Fixed-shape batches need one U for the whole job. Auto mode
+        # measures the data (probe is deterministic and identical on
+        # every process) instead of assuming the next_pow2(B*L) worst
+        # case — a ~50x smaller gather/scatter per step at Criteo-like
+        # density; denser-than-probed batches spill, never break.
+        from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
+        uniq_bucket = cfg.uniq_bucket or probe_uniq_bucket(
+            cfg, cfg.train_files)
+        logger.info("fixed unique-row bucket: %d", uniq_bucket)
+    val_bucket = 0
+    if multi_process and cfg.validation_files:
+        val_bucket = cfg.uniq_bucket or probe_uniq_bucket(
+            cfg, cfg.validation_files)
 
     ckpt = CheckpointState(cfg.model_file)
     global_step = 0
@@ -235,7 +257,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 cfg, cfg.train_files, training=True,
                 weight_files=cfg.weight_files, shard_index=shard_index,
                 num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
-                fixed_shape=multi_process))
+                fixed_shape=multi_process, uniq_bucket=uniq_bucket))
             while True:
                 batch = next(it, None)
                 if multi_process:
@@ -258,7 +280,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         break
                     if batch is None:
                         from fast_tffm_tpu.data.pipeline import empty_batch
-                        batch = empty_batch(cfg)
+                        batch = empty_batch(cfg, uniq_bucket=uniq_bucket)
                 else:
                     if preempted:
                         stopping = True
@@ -292,7 +314,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 if multi_process:
                     auc, n = evaluate_distributed(
                         cfg, table, cfg.validation_files, mesh,
-                        shard_index, num_shards)
+                        shard_index, num_shards, uniq_bucket=val_bucket)
                 else:
                     auc, n = evaluate(cfg, table, cfg.validation_files,
                                       mesh=mesh)
@@ -306,7 +328,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                   vocabulary_size=cfg.vocabulary_size, force=True)
         if multi_process:
             _chief_finalize(cfg, table, logger, mesh, shard_index,
-                            num_shards, last_val)
+                            num_shards, last_val, val_bucket)
         else:
             export_npz(table, cfg.model_file + ".npz",
                        vocabulary_size=cfg.vocabulary_size)
@@ -340,7 +362,7 @@ EXPORT_NPZ_MAX_BYTES = 2 << 30
 
 def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
                     shard_index: int, num_shards: int,
-                    last_val=None) -> None:
+                    last_val=None, val_bucket: int = 0) -> None:
     """Multi-process epilogue: final validation AUC via the sharded
     score fn (table stays row-sharded; only binned histograms cross
     hosts), then a size-gated dense export assembled chunk-by-chunk so
@@ -354,7 +376,7 @@ def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
         if last_val is None:  # e.g. preemption cut the epoch short
             last_val = evaluate_distributed(
                 cfg, table, cfg.validation_files, mesh, shard_index,
-                num_shards)
+                num_shards, uniq_bucket=val_bucket)
         if jax.process_index() == 0:
             logger.info("final validation AUC %.6f over %d examples",
                         *last_val)
