@@ -1,0 +1,149 @@
+"""Pluggable embedding-lookup backends — the SparseCore/offload seam.
+
+The reference keeps its embedding behind TF's parameter-server variable
+machinery (SURVEY.md §2 "Model parameters", §3.2): workers gather only
+the batch-active rows and push sparse Adagrad updates; the table's
+storage (how many PS tasks, where the blocks live) is invisible to the
+training math. This module makes that seam explicit for the TPU rebuild
+(BASELINE config #5: 10^9 hashed features need the table OUTSIDE device
+HBM):
+
+- the jitted compute owns everything between ``gathered rows in`` and
+  ``row gradients out`` (models/fm.py ``grad_body``/``rows_score_body``);
+- a backend owns storage, ``gather`` and the sparse-Adagrad ``apply``.
+
+Backends:
+
+- **device** (default, not in this file): table + accumulator live as
+  jax arrays — single-device or mesh row-sharded — with gather/update
+  fused into the train-step jit (models/fm.py, parallel/sharded.py).
+  Fastest when the table fits device memory; the mesh scales it the way
+  adding PS tasks did.
+- **host** (``HostOffloadLookup``): table + accumulator live in host
+  RAM; the device only ever holds the batch's ``[U, D]`` gathered rows
+  and their gradients. This is the offload *shape*: an
+  accelerator-external embedding store with batched gather/update.
+  A SparseCore implementation (jax-tpu-embedding) or a pinned-host DMA
+  implementation (``memory_kind="pinned_host"`` shardings; this
+  environment's tunnelled compiler rejects host-memory gather programs)
+  drops in behind the same three methods with no change above the seam.
+
+Storage layout is the checkpoint layout ([ckpt_rows, D], 4096-aligned —
+config.FmConfig.ckpt_rows) so save/restore is allocation-free.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from fast_tffm_tpu.config import FmConfig
+
+
+class HostOffloadLookup:
+    """Host-RAM embedding store with vectorized sparse Adagrad.
+
+    ``uniq_ids`` rows are unique by the pipeline's host-side dedup
+    (padding slots repeat ``pad_id``, but their gradients are masked to
+    zero, so plain fancy-indexed updates are exact — no ``np.add.at``
+    slow path needed).
+    """
+
+    # Above this many rows, initialization happens host-side (numpy) in
+    # place; below it, we mirror models.fm.init_table exactly (same jax
+    # PRNG stream) so backends are interchangeable in tests.
+    _DEVICE_INIT_MAX_ROWS = 1 << 24
+
+    def __init__(self, cfg: FmConfig, seed: int = 0,
+                 _init: bool = True):
+        self.cfg = cfg
+        self.rows = cfg.ckpt_rows
+        self.dim = cfg.row_dim
+        if not _init:
+            self.table = np.zeros((self.rows, self.dim), np.float32)
+        elif cfg.num_rows <= self._DEVICE_INIT_MAX_ROWS:
+            from fast_tffm_tpu.models.fm import init_table
+            self.table = np.zeros((self.rows, self.dim), np.float32)
+            self.table[:cfg.num_rows] = np.asarray(init_table(cfg, seed))
+        else:
+            # Huge tables never touch a device: host-side init with the
+            # same distribution (PRNG stream differs from the device
+            # init — irrelevant at this scale, documented).
+            rng = np.random.default_rng(seed)
+            self.table = np.zeros((self.rows, self.dim), np.float32)
+            r = cfg.init_value_range
+            chunk = 1 << 22
+            for a in range(0, cfg.num_rows - 1, chunk):
+                b = min(a + chunk, cfg.num_rows - 1)
+                self.table[a:b] = rng.uniform(
+                    -r, r, size=(b - a, self.dim)).astype(np.float32)
+        self.acc = np.full((self.rows, self.dim), cfg.adagrad_init,
+                           np.float32)
+
+    # --- the three seam methods -------------------------------------
+
+    def gather(self, uniq_ids: np.ndarray) -> np.ndarray:
+        """[U] ids -> [U, D] rows (pad ids hit the dead zero row)."""
+        return self.table[uniq_ids]
+
+    def apply_grad(self, uniq_ids: np.ndarray, grad_rows: np.ndarray,
+                   lr: float) -> None:
+        """Sparse Adagrad on the touched rows: acc += g^2;
+        table -= lr * g / sqrt(acc). Mirrors models.fm
+        sparse_adagrad_apply (same math, host-side)."""
+        g = np.asarray(grad_rows, dtype=np.float32)
+        ids = np.asarray(uniq_ids)
+        a = self.acc[ids] + np.square(g)
+        self.acc[ids] = a
+        self.table[ids] -= lr * g / np.sqrt(a)
+
+    def state(self):
+        """(table, acc) in the checkpoint layout — zero-copy."""
+        return self.table, self.acc
+
+    # --- persistence -------------------------------------------------
+
+    def load(self, table: np.ndarray, acc: np.ndarray) -> None:
+        if table.shape != self.table.shape:
+            raise ValueError(f"restored table shape {table.shape} != "
+                             f"{self.table.shape}")
+        self.table = np.asarray(table, np.float32)
+        self.acc = np.asarray(acc, np.float32)
+
+    @classmethod
+    def from_checkpoint(cls, cfg: FmConfig) -> "HostOffloadLookup":
+        """Restore straight into host RAM (numpy templates keep orbax
+        off the device: a config-#5 table would not fit there)."""
+        from fast_tffm_tpu.checkpoint import CheckpointState
+        from fast_tffm_tpu.train import check_restored_vocab
+        ckpt = CheckpointState(cfg.model_file)
+        shape = (cfg.ckpt_rows, cfg.row_dim)
+        template = {"table": np.zeros(shape, np.float32),
+                    "acc": np.zeros(shape, np.float32),
+                    "step": 0, "vocab": 0}
+        restored = ckpt.restore(template=template)
+        ckpt.close()
+        if restored is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {cfg.model_file}.ckpt")
+        check_restored_vocab(cfg, restored)
+        self = cls(cfg, _init=False)
+        self.load(restored["table"], restored["acc"])
+        return self
+
+
+def memory_report() -> dict:
+    """Host RSS and device memory stats, for the offload smoke's
+    accounting (tools/offload_smoke.py)."""
+    import resource
+    out = {"host_rss_mb": resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss // 1024}
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+        out["device_in_use_mb"] = stats.get("bytes_in_use", 0) >> 20
+        out["device_limit_mb"] = stats.get("bytes_limit", 0) >> 20
+    except Exception:
+        pass
+    return out
