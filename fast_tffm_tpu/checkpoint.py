@@ -30,18 +30,63 @@ class CheckpointState:
                                                  create=True))
 
     def save(self, step: int, table: jax.Array, acc: jax.Array,
-             vocabulary_size: int, force: bool = False) -> None:
+             vocabulary_size: int, force: bool = False,
+             wait: bool = False) -> None:
         """``vocabulary_size`` is stored alongside the arrays: the
         4096-aligned row layout means a changed vocab inside the same
         bucket would otherwise restore shape-compatibly but silently
-        scramble the pad-row invariant (callers verify on restore)."""
-        self._mngr.save(step,
-                        args=ocp.args.StandardSave(
-                            {"table": table, "acc": acc,
-                             "step": np.int64(step),
-                             "vocab": np.int64(vocabulary_size)}),
-                        force=force)
+        scramble the pad-row invariant (callers verify on restore).
+
+        Saves are ASYNC by default: orbax snapshots the arrays to host
+        and serializes in a background thread, so the train loop resumes
+        after the snapshot instead of stalling for the full write (the
+        reference's Saver writes synchronously; SURVEY §5 — this is the
+        orbax upgrade that survey section calls for). A save issued
+        while the previous one is still writing waits for it first
+        (orbax's own back-pressure), bounding in-flight state to one
+        snapshot. ``wait=True`` — the final/preemption save — blocks
+        until the bytes are durably committed before returning."""
+        try:
+            self._mngr.save(step,
+                            args=ocp.args.StandardSave(
+                                {"table": table, "acc": acc,
+                                 "step": np.int64(step),
+                                 "vocab": np.int64(vocabulary_size)}),
+                            force=force)
+        except ocp.checkpoint_manager.StepAlreadyExistsError:
+            # The final/preemption save can land on the same step as the
+            # last periodic save (save_steps divides the step count).
+            # State at a given step is unique, so this is a no-op — and
+            # orbax's `force` does not cover the already-exists case.
+            pass
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def wait_until_finished(self) -> None:
         self._mngr.wait_until_finished()
+
+    def restore_partial(self, template: Dict[str, Any],
+                        step: Optional[int] = None
+                        ) -> Optional[Dict[str, Any]]:
+        """Restore only the leaves named in ``template`` (a subtree of
+        what was saved). The offload predict path uses this to load the
+        table WITHOUT the same-sized Adagrad accumulator — at config-#5
+        scale the accumulator is half the state, and materializing it
+        just to drop it doubles peak host RSS. Uses a read-only
+        PyTree-handler manager (StandardSave's on-disk format is the
+        PyTree format; partial restore is a PyTreeRestore feature)."""
+        self._mngr.wait_until_finished()
+        s = step if step is not None else self.latest_step()
+        if s is None:
+            return None
+        reader = ocp.CheckpointManager(
+            self.directory, item_handlers=ocp.PyTreeCheckpointHandler())
+        try:
+            return reader.restore(
+                s, args=ocp.args.PyTreeRestore(item=template,
+                                               partial_restore=True))
+        finally:
+            reader.close()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
@@ -53,6 +98,7 @@ class CheckpointState:
         checkpoint exists yet (fresh start). ``template`` is an abstract
         pytree (jax.ShapeDtypeStruct leaves) matching what was saved;
         required by orbax to reconstruct arrays."""
+        self._mngr.wait_until_finished()  # an in-flight async save first
         s = step if step is not None else self.latest_step()
         if s is None:
             return None
@@ -61,15 +107,16 @@ class CheckpointState:
         try:
             return self._mngr.restore(
                 s, args=ocp.args.StandardRestore(template))
-        except ValueError as e:
-            if "shape" not in str(e).lower():
-                raise
-            # Orbax's shape error suggests enabling truncation — wrong
-            # advice here: a shape mismatch means the checkpoint was
-            # written under a different config or storage layout.
+        except (ValueError, KeyError) as e:
+            # Orbax surfaces config-mismatch as a shape ValueError (whose
+            # advice — enable truncation — is wrong here) or, for a
+            # checkpoint predating a template key such as 'vocab', as a
+            # tree-structure error. Both mean the same thing to a user:
+            # the checkpoint was written under a different config or an
+            # older storage layout.
             raise ValueError(
                 f"checkpoint at {self.directory} step {s} does not match "
-                "this config's shapes: it was written under a different "
+                "this config's layout: it was written under a different "
                 "config (vocabulary_size / factor_num / model_type) or an "
                 "older storage layout. Retrain, or point model_file at "
                 f"the matching checkpoint. Underlying error: {e}") from e

@@ -41,6 +41,12 @@ class FmConfig:
     model_type: str = "fm"          # "fm" | "ffm"
     order: int = 2                  # >= 2; order>2 uses the ANOVA kernel
     field_num: int = 0              # > 0 required for model_type == "ffm"
+    # Embedding-lookup backend (BASELINE config #5; lookup.py):
+    # "device" keeps table+accumulator as (mesh-shardable) jax arrays with
+    # gather/update fused into the train-step jit; "host" stores them in
+    # host RAM (tables too big for device memory) and ships only the
+    # batch's [U, D] gathered rows / row gradients across the boundary.
+    lookup: str = "device"          # "device" | "host"
 
     # --- [Train] -----------------------------------------------------------
     train_files: Tuple[str, ...] = ()
@@ -99,6 +105,8 @@ class FmConfig:
             raise ValueError(f"unknown loss_type {self.loss_type!r}")
         if self.kernel not in ("xla", "pallas"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
+        if self.lookup not in ("device", "host"):
+            raise ValueError(f"unknown lookup {self.lookup!r}")
         if self.factor_num <= 0:
             raise ValueError("factor_num must be positive")
         if self.vocabulary_size <= 0:
@@ -157,6 +165,7 @@ _GENERAL_KEYS = {
     "model_type": str,
     "order": int,
     "field_num": int,
+    "lookup": str,
 }
 _TRAIN_KEYS = {
     "train_files": _split_files,

@@ -12,24 +12,27 @@ HBM):
   ``row gradients out`` (models/fm.py ``grad_body``/``rows_score_body``);
 - a backend owns storage, ``gather`` and the sparse-Adagrad ``apply``.
 
-Backends:
+Backends (selected by ``FmConfig.lookup``):
 
-- **device** (default, not in this file): table + accumulator live as
-  jax arrays — single-device or mesh row-sharded — with gather/update
-  fused into the train-step jit (models/fm.py, parallel/sharded.py).
+- **device** (default): table + accumulator live as jax arrays —
+  single-device or mesh row-sharded — with gather/update fused into the
+  train-step jit (models/fm.py train_step_body, parallel/sharded.py).
   Fastest when the table fits device memory; the mesh scales it the way
   adding PS tasks did.
 - **host** (``HostOffloadLookup``): table + accumulator live in host
   RAM; the device only ever holds the batch's ``[U, D]`` gathered rows
-  and their gradients. This is the offload *shape*: an
-  accelerator-external embedding store with batched gather/update.
-  A SparseCore implementation (jax-tpu-embedding) or a pinned-host DMA
-  implementation (``memory_kind="pinned_host"`` shardings; this
-  environment's tunnelled compiler rejects host-memory gather programs)
-  drops in behind the same three methods with no change above the seam.
+  and their gradients (train.py/predict.py route through
+  ``make_grad_fn``/``make_rows_score_fn`` when ``lookup = host``).
+  This is the offload *shape*: an accelerator-external embedding store
+  with batched gather/update. A SparseCore implementation
+  (jax-tpu-embedding) or a pinned-host DMA implementation
+  (``memory_kind="pinned_host"`` shardings; this environment's
+  tunnelled compiler rejects host-memory gather programs) drops in
+  behind the same three methods with no change above the seam.
 
 Storage layout is the checkpoint layout ([ckpt_rows, D], 4096-aligned —
 config.FmConfig.ckpt_rows) so save/restore is allocation-free.
+``tools/offload_smoke.py`` runs the at-scale accounting check.
 """
 
 from __future__ import annotations
@@ -61,8 +64,14 @@ class HostOffloadLookup:
         self.rows = cfg.ckpt_rows
         self.dim = cfg.row_dim
         if not _init:
-            self.table = np.zeros((self.rows, self.dim), np.float32)
-        elif cfg.num_rows <= self._DEVICE_INIT_MAX_ROWS:
+            # Restore path: allocate nothing — load()/from_checkpoint
+            # assign the restored arrays directly, so peak host memory is
+            # one copy of the state, not two (a config-#5 table is tens
+            # of GB; a transient second copy is an OOM).
+            self.table: Optional[np.ndarray] = None
+            self.acc: Optional[np.ndarray] = None
+            return
+        if cfg.num_rows <= self._DEVICE_INIT_MAX_ROWS:
             from fast_tffm_tpu.models.fm import init_table
             self.table = np.zeros((self.rows, self.dim), np.float32)
             self.table[:cfg.num_rows] = np.asarray(init_table(cfg, seed))
@@ -104,32 +113,50 @@ class HostOffloadLookup:
 
     # --- persistence -------------------------------------------------
 
-    def load(self, table: np.ndarray, acc: np.ndarray) -> None:
-        if table.shape != self.table.shape:
+    def load(self, table: np.ndarray,
+             acc: Optional[np.ndarray] = None) -> None:
+        """``acc=None`` leaves the accumulator unset — valid for
+        gather/score-only use (predict); ``apply_grad`` would fault."""
+        expect = (self.rows, self.dim)
+        if tuple(table.shape) != expect:
             raise ValueError(f"restored table shape {table.shape} != "
-                             f"{self.table.shape}")
+                             f"{expect}")
+        # No-copy when the restored arrays are already f32 numpy (the
+        # orbax restore path): at offload scale a dtype-converting copy
+        # here would double peak memory.
         self.table = np.asarray(table, np.float32)
-        self.acc = np.asarray(acc, np.float32)
+        self.acc = None if acc is None else np.asarray(acc, np.float32)
 
     @classmethod
-    def from_checkpoint(cls, cfg: FmConfig) -> "HostOffloadLookup":
-        """Restore straight into host RAM (numpy templates keep orbax
-        off the device: a config-#5 table would not fit there)."""
+    def from_checkpoint(cls, cfg: FmConfig,
+                        with_acc: bool = True) -> "HostOffloadLookup":
+        """Restore straight into host RAM. The template's abstract
+        sharding-free leaves make orbax materialize plain np.ndarrays —
+        nothing lands on a device (a config-#5 table would not fit
+        there) and no throwaway template arrays are allocated.
+
+        ``with_acc=False`` (the predict path) restores the table leaf
+        only: inference never touches the Adagrad accumulator, and at
+        offload scale materializing it would double peak host RSS."""
         from fast_tffm_tpu.checkpoint import CheckpointState
-        from fast_tffm_tpu.train import check_restored_vocab
+        from fast_tffm_tpu.train import (check_restored_vocab,
+                                         checkpoint_template)
         ckpt = CheckpointState(cfg.model_file)
-        shape = (cfg.ckpt_rows, cfg.row_dim)
-        template = {"table": np.zeros(shape, np.float32),
-                    "acc": np.zeros(shape, np.float32),
-                    "step": 0, "vocab": 0}
-        restored = ckpt.restore(template=template)
+        template = checkpoint_template(cfg, host=True)
+        if with_acc:
+            restored = ckpt.restore(template=template)
+        else:
+            template.pop("acc")
+            restored = ckpt.restore_partial(template)
         ckpt.close()
         if restored is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {cfg.model_file}.ckpt")
         check_restored_vocab(cfg, restored)
         self = cls(cfg, _init=False)
-        self.load(restored["table"], restored["acc"])
+        self.load(np.asarray(restored["table"]),
+                  np.asarray(restored["acc"]) if with_acc else None)
+        self.step = int(restored["step"])
         return self
 
 

@@ -131,16 +131,19 @@ def sparse_adagrad_apply(table: jax.Array, acc: jax.Array,
     return table.at[uniq_ids].add(upd), acc
 
 
-def train_step_body(spec: ModelSpec, table, acc, labels, weights, uniq_ids,
-                    local_idx, vals, fields=None):
-    """One full training step (gather -> loss -> grad -> sparse Adagrad).
+def grad_body(spec: ModelSpec, gathered, labels, weights, uniq_ids,
+              local_idx, vals, fields=None):
+    """The device-side compute between a lookup backend's ``gather`` and
+    ``apply_grad`` (lookup.py): loss/scores plus gradients w.r.t. the
+    gathered ``[U, D]`` rows, padding rows masked to zero.
 
-    Pure function of arrays; jitted directly by make_train_step and jitted
-    with mesh shardings by parallel/sharded.py — single source of truth for
-    the step semantics either way.
+    This is the seam the reference gets from TF autodiff stopping at the
+    embedding_lookup boundary (SURVEY §3.2: workers compute IndexedSlices
+    row gradients; where the rows *live* — PS task, device shard, host
+    RAM — is the backend's business). ``train_step_body`` composes it
+    with the in-jit device backend; HostOffloadLookup composes it with a
+    host-RAM store.
     """
-    gathered = table[uniq_ids]
-
     def loss_fn(g):
         return loss_and_scores(spec, g, labels, weights, uniq_ids,
                                local_idx, vals, fields)
@@ -148,7 +151,30 @@ def train_step_body(spec: ModelSpec, table, acc, labels, weights, uniq_ids,
     (loss, scores), grad = jax.value_and_grad(
         loss_fn, has_aux=True)(gathered)
     live = (uniq_ids < spec.vocabulary_size).astype(grad.dtype)[:, None]
-    grad = grad * live
+    return loss, scores, grad * live
+
+
+@functools.lru_cache(maxsize=None)
+def make_grad_fn(spec: ModelSpec):
+    """Jitted grad_body: (gathered, labels, weights, uniq_ids, local_idx,
+    vals[, fields]) -> (loss, scores, grad_rows). The offload train path:
+    only [U, D] rows and their gradients ever cross the host boundary."""
+    return jax.jit(functools.partial(grad_body, spec))
+
+
+def train_step_body(spec: ModelSpec, table, acc, labels, weights, uniq_ids,
+                    local_idx, vals, fields=None):
+    """One full training step (gather -> loss -> grad -> sparse Adagrad).
+
+    Pure function of arrays; jitted directly by make_train_step and jitted
+    with mesh shardings by parallel/sharded.py — single source of truth for
+    the step semantics either way. The gather + apply pair here IS the
+    device lookup backend, fused into the jit (lookup.py documents the
+    seam; grad_body is the shared middle).
+    """
+    gathered = table[uniq_ids]
+    loss, scores, grad = grad_body(spec, gathered, labels, weights,
+                                   uniq_ids, local_idx, vals, fields)
     table, acc = sparse_adagrad_apply(table, acc, uniq_ids, grad,
                                       spec.learning_rate)
     return table, acc, loss, scores
@@ -165,13 +191,27 @@ def make_train_step(spec: ModelSpec):
                    donate_argnums=(0, 1))
 
 
+def rows_score_body(spec: ModelSpec, gathered, local_idx, vals,
+                    fields=None):
+    """Inference forward from already-gathered rows — the score-side half
+    of the lookup seam (offload predict: host gathers, device scores)."""
+    return _scores(spec, gathered, local_idx, vals, fields)
+
+
+@functools.lru_cache(maxsize=None)
+def make_rows_score_fn(spec: ModelSpec):
+    """Jitted rows_score_body: (gathered, local_idx, vals[, fields]) ->
+    raw scores [B]."""
+    return jax.jit(functools.partial(rows_score_body, spec))
+
+
 def score_body(spec: ModelSpec, table, uniq_ids, local_idx, vals,
                fields=None):
     """Inference forward (gather -> scorer). Shared by the single-device
     and mesh-sharded score functions — single source of truth, like
     train_step_body."""
     gathered = table[uniq_ids]
-    return _scores(spec, gathered, local_idx, vals, fields)
+    return rows_score_body(spec, gathered, local_idx, vals, fields)
 
 
 @functools.lru_cache(maxsize=None)
@@ -180,6 +220,34 @@ def make_score_fn(spec: ModelSpec):
     raw scores [B] (the predict driver applies sigmoid for logistic).
     Cached per spec — callers may re-request it per file/epoch."""
     return jax.jit(functools.partial(score_body, spec))
+
+
+def make_batch_scorer(spec: ModelSpec, mesh=None, backend=None):
+    """The one dispatch over the three inference paths — plain jit,
+    mesh-sharded, lookup-backend offload (lookup.py) — shared by
+    evaluate() and predict_scores() so a new backend wires in exactly
+    once. Returns ``score(table, args) -> np.ndarray`` where ``args`` is
+    a batch_args() dict WITHOUT labels/weights (consumed destructively:
+    the offload path pops uniq_ids)."""
+    if backend is not None:
+        rows_fn = make_rows_score_fn(spec)
+
+        def score(table, args):
+            gathered = backend.gather(args.pop("uniq_ids"))
+            return np.asarray(rows_fn(gathered, **args))
+    elif mesh is not None:
+        from fast_tffm_tpu.parallel.sharded import (make_sharded_score_fn,
+                                                    shard_batch)
+        fn = make_sharded_score_fn(spec, mesh)
+
+        def score(table, args):
+            return np.asarray(fn(table, **shard_batch(mesh, **args)))
+    else:
+        fn = make_score_fn(spec)
+
+        def score(table, args):
+            return np.asarray(fn(table, **args))
+    return score
 
 
 def batch_args(batch: DeviceBatch) -> Dict[str, np.ndarray]:

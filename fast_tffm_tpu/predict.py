@@ -20,7 +20,8 @@ from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
                                          prefetch)
 from fast_tffm_tpu.metrics import sigmoid
-from fast_tffm_tpu.models.fm import ModelSpec, batch_args, make_score_fn
+from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
+                                     make_batch_scorer)
 from fast_tffm_tpu.utils.logging import get_logger
 
 
@@ -50,17 +51,14 @@ def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
 
 
 def predict_scores(cfg: FmConfig, table: jax.Array, files,
-                   mesh=None) -> np.ndarray:
+                   mesh=None, backend=None) -> np.ndarray:
     """Raw scores for every example in ``files``, in input order. With a
     mesh, the batch is data-sharded and scored against the row-sharded
-    table in place (table shape [ckpt_rows, D])."""
+    table in place (table shape [ckpt_rows, D]). With a lookup
+    ``backend`` (lookup.HostOffloadLookup), rows are gathered host-side
+    and only [U, D] blocks reach the device (``table`` is unused)."""
     spec = ModelSpec.from_config(cfg)
-    if mesh is not None:
-        from fast_tffm_tpu.parallel.sharded import (make_sharded_score_fn,
-                                                    shard_batch)
-        score_fn = make_sharded_score_fn(spec, mesh)
-    else:
-        score_fn = make_score_fn(spec)
+    score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
     out: List[np.ndarray] = []
     # keep_empty: blank input lines become zero-feature examples so the
     # score file stays line-aligned with the input (SURVEY §3.4).
@@ -68,9 +66,7 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
                                          epochs=1, keep_empty=True)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
-        if mesh is not None:
-            args = shard_batch(mesh, **args)
-        scores = np.asarray(score_fn(table, **args))
+        scores = score_fn(table, args)
         out.append(scores[:batch.num_real])
     return (np.concatenate(out) if out
             else np.zeros(0, dtype=np.float32))
@@ -85,7 +81,15 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None) -> List[str]:
     jitted scorer."""
     logger = get_logger(log_file=cfg.log_file or None)
     mesh = None
-    if jax.device_count() > 1:
+    backend = None
+    if cfg.lookup == "host" and table is None:
+        # Offload predict (lookup.py seam): restore straight into host
+        # RAM; the device only ever sees per-batch [U, D] row blocks.
+        from fast_tffm_tpu.lookup import HostOffloadLookup
+        backend = HostOffloadLookup.from_checkpoint(cfg, with_acc=False)
+        logger.info("host-offload predict: table [%d, %d] in host RAM",
+                    backend.rows, backend.dim)
+    elif jax.device_count() > 1:
         from fast_tffm_tpu.parallel.sharded import make_mesh, place_table
         try:
             mesh = make_mesh()
@@ -105,12 +109,13 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None) -> List[str]:
                         dict(mesh.shape), jax.device_count())
             if table is not None and int(table.shape[0]) != cfg.ckpt_rows:
                 table = place_table(cfg, mesh, table)
-    if table is None:
+    if table is None and backend is None:
         table = load_table(cfg, mesh)
     os.makedirs(cfg.score_path, exist_ok=True)
     written = []
     for path in expand_files(cfg.predict_files):
-        raw = predict_scores(cfg, table, [path], mesh=mesh)
+        raw = predict_scores(cfg, table, [path], mesh=mesh,
+                             backend=backend)
         vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
         out_path = os.path.join(cfg.score_path,
                                 os.path.basename(path) + ".score")

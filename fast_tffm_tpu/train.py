@@ -24,7 +24,7 @@ from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
 from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
-                                     init_table, make_score_fn,
+                                     init_table, make_batch_scorer,
                                      make_train_step)
 from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.timing import StepTimer, trace_span
@@ -32,25 +32,20 @@ from fast_tffm_tpu.utils.timing import StepTimer, trace_span
 
 def evaluate(cfg: FmConfig, table: jax.Array, files,
              max_batches: Optional[int] = None,
-             mesh=None) -> Tuple[float, int]:
+             mesh=None, backend=None) -> Tuple[float, int]:
     """Streamed AUC over ``files``; returns (auc, n_examples). Pass the
-    training mesh to score a row-sharded table in place."""
+    training mesh to score a row-sharded table in place, or a lookup
+    ``backend`` (lookup.HostOffloadLookup) to score a host-offloaded
+    table (``table`` is then unused)."""
     spec = ModelSpec.from_config(cfg)
-    if mesh is not None:
-        from fast_tffm_tpu.parallel.sharded import (make_sharded_score_fn,
-                                                    shard_batch)
-        score_fn = make_sharded_score_fn(spec, mesh)
-    else:
-        score_fn = make_score_fn(spec)
+    score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
     auc = StreamingAUC()
     n = 0
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
-        if mesh is not None:
-            args = shard_batch(mesh, **args)
-        scores = np.asarray(score_fn(table, **args))
+        scores = score_fn(table, args)
         auc.update(scores[:batch.num_real], batch.labels[:batch.num_real])
         n += batch.num_real
         if max_batches and n >= max_batches * cfg.batch_size:
@@ -136,8 +131,17 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
 
     spec = ModelSpec.from_config(cfg)
     multi_process = jax.process_count() > 1
+    offload = cfg.lookup == "host"
+    if offload and multi_process:
+        # Multi-host offload would row-shard the host table across
+        # processes (each host serving its row range, a literal PS) —
+        # not built; the device mesh already covers multi-chip scale.
+        raise ValueError(
+            "lookup = host is single-process: the host-RAM table has no "
+            "cross-process sharding; use lookup = device for distributed "
+            "training")
     mesh = None
-    if jax.device_count() > 1:
+    if jax.device_count() > 1 and not offload:
         # More than one device (one host of a TPU slice, or the whole
         # jax.distributed job): row-shard the table over the global mesh
         # and data-shard the batch (parallel/sharded.py). One device:
@@ -180,12 +184,43 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
 
     ckpt = CheckpointState(cfg.model_file)
     global_step = 0
-    restored = ckpt.restore(template=checkpoint_template(cfg, mesh))
+    restored = ckpt.restore(
+        template=checkpoint_template(cfg, mesh, host=offload))
     if restored is not None:
         check_restored_vocab(cfg, restored)
         global_step = int(restored["step"])
         logger.info("restored checkpoint at step %d", global_step)
-    if mesh is not None:
+    lk = None
+    if offload:
+        # Host-offload backend (lookup.py; BASELINE config #5): the
+        # table/accumulator stay in host RAM, the jitted device program
+        # is grad_body — [U, D] rows in, loss/scores/row-grads out — and
+        # the host applies the sparse Adagrad update.
+        from fast_tffm_tpu.lookup import HostOffloadLookup
+        from fast_tffm_tpu.models.fm import make_grad_fn
+        if restored is not None:
+            lk = HostOffloadLookup(cfg, _init=False)
+            lk.load(np.asarray(restored["table"]),
+                    np.asarray(restored["acc"]))
+        else:
+            lk = HostOffloadLookup(cfg, cfg.seed)
+        logger.info("host-offload lookup: table [%d, %d] in host RAM "
+                    "(%.2f GB + accumulator)", lk.rows, lk.dim,
+                    lk.rows * lk.dim * 4 / 2**30)
+        grad_fn = make_grad_fn(spec)
+        table = acc = None
+
+        def step_fn(_t, _a, labels, weights, uniq_ids, local_idx, vals,
+                    fields=None):
+            gathered = lk.gather(uniq_ids)
+            loss, scores, grad = grad_fn(gathered, labels, weights,
+                                         uniq_ids, local_idx, vals,
+                                         fields)
+            # np.asarray blocks on the device grad — inherent to
+            # offload: the host update needs the bytes.
+            lk.apply_grad(uniq_ids, np.asarray(grad), cfg.learning_rate)
+            return None, None, loss, scores
+    elif mesh is not None:
         if restored is not None:
             # The sharded template already placed these row-sharded on
             # this mesh in the runtime [ckpt_rows, D] layout — use as-is.
@@ -233,7 +268,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             profiling = True
         elif profiling and step_done >= (cfg.profile_start_step
                                          + cfg.profile_num_steps):
-            jax.block_until_ready(table)
+            if table is not None:
+                jax.block_until_ready(table)
             jax.profiler.stop_trace()
             profiling = False
             logger.info("profiler trace written to %s", cfg.profile_dir)
@@ -308,8 +344,16 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         global_step, epoch, loss_val,
                         timer.examples_per_sec)
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
-                    ckpt.save(global_step, *ckpt_state(cfg, table, acc),
-                              vocabulary_size=cfg.vocabulary_size)
+                    state = (lk.state() if offload
+                             else ckpt_state(cfg, table, acc))
+                    # Device arrays: async save (orbax D2H-snapshots
+                    # synchronously, writes in background — the loop
+                    # doesn't stall for serialization). Host-offload
+                    # state: wait, because the background writer would
+                    # race the in-place numpy Adagrad updates.
+                    ckpt.save(global_step, *state,
+                              vocabulary_size=cfg.vocabulary_size,
+                              wait=offload)
             if cfg.validation_files and not stopping:
                 if multi_process:
                     auc, n = evaluate_distributed(
@@ -317,18 +361,33 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         shard_index, num_shards, uniq_bucket=val_bucket)
                 else:
                     auc, n = evaluate(cfg, table, cfg.validation_files,
-                                      mesh=mesh)
+                                      mesh=mesh, backend=lk)
                 last_val = (auc, n)
                 if jax.process_index() == 0:
                     logger.info(
                         "epoch %d validation AUC %.6f over %d examples",
                         epoch, auc, n)
         loss_val = float(loss) if loss is not None else loss_val
-        ckpt.save(global_step, *ckpt_state(cfg, table, acc),
-                  vocabulary_size=cfg.vocabulary_size, force=True)
+        state = lk.state() if offload else ckpt_state(cfg, table, acc)
+        # Final/preemption save: barrier until durably written — the
+        # process may exit right after.
+        ckpt.save(global_step, *state,
+                  vocabulary_size=cfg.vocabulary_size, force=True,
+                  wait=True)
         if multi_process:
             _chief_finalize(cfg, table, logger, mesh, shard_index,
                             num_shards, last_val, val_bucket)
+        elif offload:
+            nbytes = cfg.num_rows * cfg.row_dim * 4
+            if nbytes > EXPORT_NPZ_MAX_BYTES:
+                logger.info(
+                    "skipping dense .npz export: offloaded table is "
+                    "%.1f GB > %.1f GB threshold; use the checkpoint at "
+                    "%s.ckpt", nbytes / 2**30,
+                    EXPORT_NPZ_MAX_BYTES / 2**30, cfg.model_file)
+            else:
+                export_npz(lk.table, cfg.model_file + ".npz",
+                           vocabulary_size=cfg.vocabulary_size)
         else:
             export_npz(table, cfg.model_file + ".npz",
                        vocabulary_size=cfg.vocabulary_size)
@@ -350,6 +409,10 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
                 global_step, loss_val, timer.examples_per_sec)
     ckpt.close()
+    if offload:
+        # The logical table as host numpy (the offload analogue of the
+        # device table return; dead ckpt-alignment tail sliced off).
+        return lk.table[:cfg.num_rows]
     return table
 
 
@@ -427,15 +490,23 @@ def ckpt_state(cfg: FmConfig, table: jax.Array, acc: jax.Array):
             jnp.concatenate([acc, pad_a], axis=0))
 
 
-def checkpoint_template(cfg: FmConfig, mesh=None):
+def checkpoint_template(cfg: FmConfig, mesh=None, host: bool = False):
     """Abstract pytree matching CheckpointState.save's layout — orbax
     needs it to restore from a process that didn't do the saving.
 
     The explicit sharding makes restore topology-portable: orbax places
     the arrays per THIS run's layout instead of repopulating whatever
     sharding the saving topology recorded (which, for a multi-host save
-    restored elsewhere, would yield non-addressable arrays)."""
+    restored elsewhere, would yield non-addressable arrays).
+
+    ``host`` leaves the leaves sharding-free, which makes orbax restore
+    plain np.ndarrays into host RAM — the offload-backend path, where
+    the table must never land on a device."""
     shape = (cfg.ckpt_rows, cfg.row_dim)
+    if host:
+        return {"table": jax.ShapeDtypeStruct(shape, np.float32),
+                "acc": jax.ShapeDtypeStruct(shape, np.float32),
+                "step": 0, "vocab": 0}
     if mesh is not None:
         from jax.sharding import NamedSharding
         from fast_tffm_tpu.parallel.sharded import ROW_SPEC

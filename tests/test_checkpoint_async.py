@@ -1,0 +1,54 @@
+"""Async checkpointing: periodic saves must not stall the train loop for
+the full serialization (VERDICT r2 item 7); final saves barrier."""
+
+import time
+
+import numpy as np
+
+from fast_tffm_tpu.checkpoint import CheckpointState
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.models.fm import init_accumulator, init_table
+from fast_tffm_tpu.train import checkpoint_template, ckpt_state, train
+
+
+def test_async_save_returns_before_commit_and_restores(tmp_path):
+    cfg = FmConfig(vocabulary_size=200_000, factor_num=8,
+                   model_file=str(tmp_path / "m" / "fm"))
+    table, acc = ckpt_state(cfg, init_table(cfg), init_accumulator(cfg))
+    ckpt = CheckpointState(cfg.model_file)
+
+    t0 = time.perf_counter()
+    ckpt.save(1, table, acc, vocabulary_size=cfg.vocabulary_size)
+    t_async = time.perf_counter() - t0
+    ckpt.wait_until_finished()
+
+    t0 = time.perf_counter()
+    ckpt.save(2, table, acc, vocabulary_size=cfg.vocabulary_size,
+              wait=True)
+    t_sync = time.perf_counter() - t0
+    # The async call skips the serialization wait; it must be visibly
+    # cheaper than the full committed write of the same ~13 MB state.
+    assert t_async < t_sync, (t_async, t_sync)
+
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    assert int(restored["step"]) == 2
+    np.testing.assert_array_equal(np.asarray(restored["table"]),
+                                  np.asarray(table))
+    ckpt.close()
+
+
+def test_save_every_step_train_is_resumable(tmp_path, rng):
+    """save_steps=1: every step issues an async save; the run must end
+    with a committed, restorable checkpoint at the final step."""
+    from tests.test_e2e import make_dataset
+    make_dataset(tmp_path / "train.txt", 96, rng)
+    cfg = FmConfig(vocabulary_size=200, factor_num=4, batch_size=32,
+                   epoch_num=1, save_steps=1, shuffle=False,
+                   train_files=(str(tmp_path / "train.txt"),),
+                   model_file=str(tmp_path / "m" / "fm"))
+    train(cfg)
+    ckpt = CheckpointState(cfg.model_file)
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    ckpt.close()
+    assert int(restored["step"]) == 3  # 96 examples / batch 32
+    assert np.isfinite(np.asarray(restored["table"])).all()

@@ -1,0 +1,164 @@
+"""Lookup-backend seam (lookup.py; BASELINE config #5): the host-offload
+backend must be interchangeable with the fused device path — same math,
+same checkpoints, same CLI surface — with only storage/gather/apply moved
+off-device."""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+import run_tffm
+from fast_tffm_tpu.config import FmConfig, load_config
+from fast_tffm_tpu.data.pipeline import batch_iterator
+from fast_tffm_tpu.lookup import HostOffloadLookup, memory_report
+from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
+                                     init_accumulator, init_table,
+                                     make_grad_fn, make_train_step)
+from tests.test_e2e import make_dataset
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(vocabulary_size=200, factor_num=4, batch_size=32,
+                learning_rate=0.1, factor_lambda=1e-6, bias_lambda=1e-6,
+                train_files=(str(tmp_path / "train.txt"),),
+                model_file=str(tmp_path / "model" / "fm_model"),
+                shuffle=False, epoch_num=2)
+    base.update(kw)
+    return FmConfig(**base)
+
+
+def test_deferred_allocation():
+    cfg = FmConfig(vocabulary_size=100, factor_num=4)
+    lk = HostOffloadLookup(cfg, _init=False)
+    assert lk.table is None and lk.acc is None
+    with pytest.raises(ValueError, match="shape"):
+        lk.load(np.zeros((3, 3), np.float32), np.zeros((3, 3), np.float32))
+
+
+def test_host_backend_matches_device_step_for_step(tmp_path, rng):
+    """N steps through the host backend == N steps through the fused
+    device jit, batch for batch (same seam math on both sides)."""
+    make_dataset(tmp_path / "train.txt", 200, rng)
+    cfg = _cfg(tmp_path)
+    spec = ModelSpec.from_config(cfg)
+
+    table = init_table(cfg, cfg.seed)
+    acc = init_accumulator(cfg)
+    step = make_train_step(spec)
+
+    lk = HostOffloadLookup(cfg, cfg.seed)
+    grad_fn = make_grad_fn(spec)
+
+    for batch in batch_iterator(cfg, cfg.train_files, training=True,
+                                epochs=1):
+        args = batch_args(batch)
+        table, acc, loss_d, _ = step(table, acc, **args)
+        gathered = lk.gather(args["uniq_ids"])
+        loss_h, _, grad = grad_fn(gathered, **args)
+        lk.apply_grad(args["uniq_ids"], np.asarray(grad),
+                      cfg.learning_rate)
+        assert float(loss_d) == pytest.approx(float(loss_h), abs=1e-6)
+
+    np.testing.assert_allclose(lk.table[:cfg.num_rows], np.asarray(table),
+                               atol=2e-6)
+    np.testing.assert_allclose(lk.acc[:cfg.num_rows], np.asarray(acc),
+                               atol=2e-6)
+
+
+@pytest.fixture
+def host_cfg_files(tmp_path, rng):
+    train = tmp_path / "train.txt"
+    test = tmp_path / "test.txt"
+    make_dataset(train, 400, rng)
+    labels = make_dataset(test, 120, rng)
+    cfg_path = tmp_path / "fm.cfg"
+    cfg_path.write_text(textwrap.dedent(f"""
+        [General]
+        vocabulary_size = 200
+        factor_num = 4
+        model_file = {tmp_path}/model/fm_model
+        lookup = host
+
+        [Train]
+        train_files = {train}
+        validation_files = {test}
+        epoch_num = 4
+        batch_size = 32
+        learning_rate = 0.1
+        log_steps = 50
+
+        [Predict]
+        predict_files = {test}
+        score_path = {tmp_path}/score
+    """))
+    return tmp_path, cfg_path, labels
+
+
+def test_host_lookup_e2e_cli(host_cfg_files):
+    """Full CLI train -> checkpoint -> predict with lookup = host, and
+    the scores match a device-backend predict from the same checkpoint."""
+    tmp_path, cfg_path, labels = host_cfg_files
+    assert run_tffm.main(["train", str(cfg_path)]) == 0
+    assert (tmp_path / "model" / "fm_model.ckpt").is_dir()
+    assert run_tffm.main(["predict", str(cfg_path)]) == 0
+    scores_host = np.loadtxt(tmp_path / "score" / "test.txt.score")
+    assert len(scores_host) == 120
+
+    from fast_tffm_tpu.metrics import exact_auc
+    assert exact_auc(scores_host, labels) > 0.8
+
+    # Same checkpoint scored through the device backend: identical.
+    cfg = load_config(str(cfg_path))
+    import dataclasses
+    dev_cfg = dataclasses.replace(
+        cfg, lookup="device", score_path=str(tmp_path / "score_dev"))
+    from fast_tffm_tpu.predict import predict
+    predict(dev_cfg)
+    scores_dev = np.loadtxt(tmp_path / "score_dev" / "test.txt.score")
+    np.testing.assert_allclose(scores_host, scores_dev, atol=1e-5)
+
+
+def test_host_lookup_resume(host_cfg_files):
+    """from_checkpoint restores exactly what training saved, and a second
+    train run resumes from it (step counter advances, table moves)."""
+    tmp_path, cfg_path, _ = host_cfg_files
+    assert run_tffm.main(["train", str(cfg_path)]) == 0
+    cfg = load_config(str(cfg_path))
+    lk = HostOffloadLookup.from_checkpoint(cfg)
+    assert lk.table.shape == (cfg.ckpt_rows, cfg.row_dim)
+    assert lk.step > 0
+    t1 = lk.table.copy()
+
+    assert run_tffm.main(["train", str(cfg_path)]) == 0
+    lk2 = HostOffloadLookup.from_checkpoint(cfg)
+    assert lk2.step > lk.step
+    assert not np.array_equal(t1, lk2.table)
+
+
+def test_from_checkpoint_table_only(host_cfg_files):
+    """with_acc=False (predict) restores just the table leaf: the
+    accumulator — half the state at offload scale — never materializes."""
+    tmp_path, cfg_path, _ = host_cfg_files
+    assert run_tffm.main(["train", str(cfg_path)]) == 0
+    cfg = load_config(str(cfg_path))
+    full = HostOffloadLookup.from_checkpoint(cfg)
+    lean = HostOffloadLookup.from_checkpoint(cfg, with_acc=False)
+    assert lean.acc is None
+    np.testing.assert_array_equal(lean.table, full.table)
+    assert lean.step == full.step
+
+
+def test_host_lookup_rejects_multiprocess(tmp_path, rng, monkeypatch):
+    make_dataset(tmp_path / "train.txt", 50, rng)
+    cfg = _cfg(tmp_path, lookup="host")
+    import jax
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    from fast_tffm_tpu.train import train
+    with pytest.raises(ValueError, match="single-process"):
+        train(cfg)
+
+
+def test_memory_report_keys():
+    rep = memory_report()
+    assert rep["host_rss_mb"] > 0
