@@ -15,6 +15,11 @@ import dataclasses
 from typing import Optional, Tuple
 
 
+def _split_ints(raw: str) -> Tuple[int, ...]:
+    """Comma/whitespace-separated int list (bucket_ladder)."""
+    return tuple(int(x) for x in raw.replace(",", " ").split())
+
+
 def _split_files(raw: str) -> Tuple[str, ...]:
     """Comma/whitespace-separated file list (globs allowed) -> tuple."""
     out = []
@@ -66,6 +71,12 @@ class FmConfig:
     adagrad_init: float = 0.1       # TF Adagrad accumulator init default
     save_steps: int = 0             # 0 = save only at end
     log_steps: int = 100
+    # Cap per-epoch validation at this many batches PER INPUT SHARD
+    # (process) — 0 = full sweep. At Criteo-1TB scale an every-epoch
+    # full validation pass costs a complete extra data sweep. The unit
+    # is per-shard in every topology (a P-process job samples up to
+    # P x this many batches, one cap per worker's shard).
+    validation_max_batches: int = 0
     # Static-shape bucketing (TPU-specific; SURVEY §7 hard part #1):
     max_features_per_example: int = 256   # hard cap on nnz/example (truncate)
     bucket_ladder: Tuple[int, ...] = (8, 16, 32, 64, 128, 256)
@@ -111,6 +122,12 @@ class FmConfig:
             raise ValueError("factor_num must be positive")
         if self.vocabulary_size <= 0:
             raise ValueError("vocabulary_size must be positive")
+        lad = self.bucket_ladder
+        if not lad or any(b <= 0 for b in lad) or list(lad) != sorted(
+                set(lad)):
+            raise ValueError(
+                f"bucket_ladder must be a strictly increasing tuple of "
+                f"positive ints, got {lad}")
         ub = self.uniq_bucket
         if ub and (ub < 64 or ub & (ub - 1)):
             raise ValueError(
@@ -185,7 +202,9 @@ _TRAIN_KEYS = {
     "adagrad_init": float,
     "save_steps": int,
     "log_steps": int,
+    "validation_max_batches": int,
     "max_features_per_example": int,
+    "bucket_ladder": _split_ints,
     "uniq_bucket": int,
     "kernel": str,
     "profile_dir": str,

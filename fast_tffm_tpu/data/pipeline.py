@@ -40,6 +40,73 @@ class UniqOverflow(ValueError):
 
 
 @dataclasses.dataclass
+class SpillStats:
+    """Spill observability for fixed-U (multi-process) input: when a
+    batch's unique ids exceed ``uniq_bucket`` it closes early with fewer
+    real examples — correct but throughput-degrading, and invisible
+    without these counters (a dense tail the startup probe missed would
+    otherwise silently collapse effective batch size). Pass one to
+    batch_iterator and read it after the epoch; train() logs it.
+    """
+    batches: int = 0            # batches emitted
+    spilled_batches: int = 0    # closed early on the unique-row budget
+    real_examples: int = 0      # non-padding examples emitted
+    capacity: int = 0           # batches * batch_size
+
+    def count(self, num_real: int, batch_size: int,
+              spilled: bool) -> None:
+        self.batches += 1
+        self.spilled_batches += int(spilled)
+        self.real_examples += num_real
+        self.capacity += batch_size
+
+    @property
+    def spill_fraction(self) -> float:
+        return self.spilled_batches / self.batches if self.batches else 0.0
+
+    @property
+    def fill_fraction(self) -> float:
+        return (self.real_examples / self.capacity if self.capacity
+                else 1.0)
+
+    def describe(self) -> str:
+        return (f"{self.batches} batches, {self.real_examples} examples "
+                f"(fill {self.fill_fraction:.1%}), "
+                f"{self.spilled_batches} spilled "
+                f"({self.spill_fraction:.1%})")
+
+
+# Above this spilled-batch fraction the pipeline is visibly degraded by
+# an undersized uniq_bucket and train() warns with the fix.
+SPILL_WARN_FRACTION = 0.1
+
+
+def require_bounded_examples(cfg: FmConfig, context: str) -> None:
+    """Fixed-shape (multi-process) modes cap L at the ladder top; an
+    over-long example caught lazily mid-run would kill one worker
+    between collectives and hang its peers, so refuse up front.
+    max_features_per_example = 0 means "unlimited", which can never be
+    honored under a fixed L."""
+    if not (0 < cfg.max_features_per_example <= cfg.bucket_ladder[-1]):
+        raise ValueError(
+            f"{context} needs 0 < max_features_per_example "
+            f"({cfg.max_features_per_example}) <= bucket_ladder max "
+            f"({cfg.bucket_ladder[-1]}) so over-long examples are "
+            "truncated up front instead of faulting one worker mid-run")
+
+
+def effective_L_cap(cfg: FmConfig) -> int:
+    """The fixed-shape per-example feature bucket: the ladder value (a
+    power of two extended past the top if needed) covering
+    max_features_per_example. One definition shared by the fast-path
+    builder and probe_uniq_bucket — the two MUST agree or multi-process
+    shapes desynchronize across the probe/build boundary."""
+    return _ladder_fit(
+        max(cfg.bucket_ladder[-1], cfg.max_features_per_example),
+        cfg.bucket_ladder)
+
+
+@dataclasses.dataclass
 class DeviceBatch:
     """One fixed-shape batch. Shapes: B examples, L feature slots per
     example, U unique-row slots."""
@@ -275,7 +342,9 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                          n_epochs: int, shuffle: bool,
                          seed: Optional[int], fixed_shape: bool,
                          shard_index: int = 0, num_shards: int = 1,
-                         uniq_bucket: int = 0) -> Iterator[DeviceBatch]:
+                         uniq_bucket: int = 0,
+                         stats: Optional[SpillStats] = None
+                         ) -> Iterator[DeviceBatch]:
     """Chunked C++ fast path: raw file bytes stream straight into the
     C++ BatchBuilder (parse + hash + dedup + padded scatter in one native
     pass); Python never touches individual lines. Sharded input reads
@@ -299,7 +368,10 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
     window: List[DeviceBatch] = []
     window_cap = max(2, cfg.queue_size // B) if shuffle else 1
 
-    def emit(n, labels, uniq, li, vals, max_nnz) -> DeviceBatch:
+    def emit(n, labels, uniq, li, vals, max_nnz,
+             spilled: bool = False) -> DeviceBatch:
+        if stats is not None:
+            stats.count(n, B, spilled)
         L = (L_cap if fixed_shape
              else _ladder_fit(max(max_nnz, 1), cfg.bucket_ladder))
         if L < L_cap:
@@ -345,7 +417,11 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
             off += consumed
             if not full:
                 break
-            yield from drain(emit(*bb.finish()))
+            out = bb.finish()
+            # The builder returns "full" either at B examples or when a
+            # line would blow the unique budget — the latter closes the
+            # batch short (the spill being counted).
+            yield from drain(emit(*out, spilled=out[0] < B))
         tail = data[off:]  # unconsumed partial line, re-fed next chunk
 
     for _ in range(n_epochs):
@@ -372,7 +448,9 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    seed: Optional[int] = None,
                    keep_empty: bool = False,
                    fixed_shape: bool = False,
-                   uniq_bucket: int = 0) -> Iterator[DeviceBatch]:
+                   uniq_bucket: int = 0,
+                   stats: Optional[SpillStats] = None
+                   ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files.
 
     Shuffling is a bounded reservoir of ``cfg.queue_size`` lines, the same
@@ -407,9 +485,7 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             # A ladder value (power of two past the top), so batches with
             # max_features_per_example > ladder[-1] land in the same
             # extended pow2 buckets the generic path compiles for.
-            L_cap = _ladder_fit(
-                max(cfg.bucket_ladder[-1], cfg.max_features_per_example),
-                cfg.bucket_ladder)
+            L_cap = effective_L_cap(cfg)
             bb = BatchBuilder(B, L_cap, cfg.vocabulary_size,
                               hash_feature_id=cfg.hash_feature_id,
                               max_features_per_example=(
@@ -421,7 +497,7 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             yield from _fast_batch_iterator(cfg, bb, files, B, n_epochs,
                                             do_shuffle, seed, fixed_shape,
                                             shard_index, num_shards,
-                                            uniq_bucket)
+                                            uniq_bucket, stats=stats)
             return
     # keep_empty needs blank lines to become zero-feature examples; only
     # the Python parser implements that.
@@ -440,10 +516,13 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                 w = np.array([c[1] for c in chunk], dtype=np.float32)
                 block = _parse_block(lines, cfg, parse, keep_empty)
                 try:
-                    yield make_device_batch(block, cfg, weights=w,
+                    out = make_device_batch(block, cfg, weights=w,
                                             batch_size=B,
                                             fixed_shape=fixed_shape,
                                             uniq_bucket=uniq_bucket)
+                    if stats is not None:
+                        stats.count(out.num_real, B, False)
+                    yield out
                 except UniqOverflow:
                     # Spill: emit the longest example prefix that fits
                     # the unique budget; the tail reopens the queue.
@@ -456,10 +535,13 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                     pending[0:0] = chunk[m:]
                     head = _parse_block([c[0] for c in chunk[:m]], cfg,
                                         parse, keep_empty)
-                    yield make_device_batch(head, cfg, weights=w[:m],
+                    out = make_device_batch(head, cfg, weights=w[:m],
                                             batch_size=B,
                                             fixed_shape=fixed_shape,
                                             uniq_bucket=uniq_bucket)
+                    if stats is not None:
+                        stats.count(out.num_real, B, True)
+                    yield out
 
         for item in _iter_lines(files, weight_files if training else (),
                                 shard_index, num_shards,
@@ -498,34 +580,48 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
     measuring the data instead of assuming the worst case (the ladder
     top is next_pow2(B*L) — ~50x a realistic Criteo batch's uniques).
 
-    Parses the first batch of the FIRST file — every process reads the
-    same bytes, so all agree without a collective — and returns the next
-    power of two >= 2x the measured unique count (>= 64, > the
-    per-example cap, <= the ladder top). Densities the probe missed are
-    absorbed by the spill protocol, costing throughput, never
-    correctness.
+    Parses one batch each from the head, middle, and tail of the FIRST
+    file — every process reads the same bytes, so all agree without a
+    collective — and returns the next power of two >= 2x the max
+    measured unique count (>= 64, > the per-example cap, <= the ladder
+    top). Densities the probe missed are absorbed by the spill protocol,
+    costing throughput, never correctness — and counted by SpillStats so
+    a mis-probe is visible in the epoch log.
     """
     B = batch_size or cfg.batch_size
     files = expand_files(files)
-    lines: List[str] = []
-    with open(files[0]) as fh:
-        for line in fh:
-            if line.strip():
-                lines.append(line)
-            if len(lines) >= B:
-                break
-    L_cap = _ladder_fit(
-        max(cfg.bucket_ladder[-1], cfg.max_features_per_example),
-        cfg.bucket_ladder)
-    top = _uniq_ladder(B, L_cap)[-1]
-    if not lines:
-        return min(1 << 10, top)
+    top = _uniq_ladder(B, effective_L_cap(cfg))[-1]
     from fast_tffm_tpu.data.cparser import parse_lines_fast
     parse = None if cfg.model_type == "ffm" else parse_lines_fast
-    block = _parse_block(lines, cfg, parse)
-    u = len(np.unique(block.ids))
+
+    # One batch from the head, middle, and tail of the first file (byte
+    # offsets, first-newline aligned like shard_byte_range): sorted or
+    # sparse-first data whose head underestimates density would
+    # otherwise spill every denser batch downstream. Still deterministic
+    # and collective-free — every process reads the same bytes.
+    size = os.path.getsize(files[0])
+    u_max = 0
+    got_lines = False
+    for start in sorted({0, size // 3, 2 * size // 3}):
+        lines: List[str] = []
+        buf = ""
+        for chunk in _iter_owned_chunks(files[0], start, size):
+            parts = (buf + chunk.decode("utf-8", "replace")).split("\n")
+            buf = parts.pop()
+            lines.extend(l for l in parts if l.strip())
+            if len(lines) >= B:
+                break
+        if buf.strip() and len(lines) < B:
+            lines.append(buf)
+        if not lines:
+            continue
+        got_lines = True
+        block = _parse_block(lines[:B], cfg, parse)
+        u_max = max(u_max, len(np.unique(block.ids)))
+    if not got_lines:
+        return min(1 << 10, top)
     b = 64
-    while b < 2 * (u + 2) or b <= cfg.max_features_per_example:
+    while b < 2 * (u_max + 2) or b <= cfg.max_features_per_example:
         b *= 2
     return min(b, top)
 

@@ -128,6 +128,25 @@ class HostOffloadLookup:
         self.acc = None if acc is None else np.asarray(acc, np.float32)
 
     @classmethod
+    def for_table(cls, cfg: FmConfig, table) -> "HostOffloadLookup":
+        """Score-only backend around an existing host table — the
+        predict path for a caller-held table (e.g. train()'s offload
+        return value). Accepts the logical [num_rows, D] or checkpoint
+        [ckpt_rows, D] layout; gather only ever indexes rows <= pad_id,
+        so either suffices. No accumulator, no copy for f32 numpy
+        input."""
+        arr = np.asarray(table, np.float32)
+        if (arr.shape[0] not in (cfg.num_rows, cfg.ckpt_rows)
+                or arr.shape[1] != cfg.row_dim):
+            raise ValueError(
+                f"table shape {arr.shape} matches neither the logical "
+                f"[{cfg.num_rows}, {cfg.row_dim}] nor the checkpoint "
+                f"[{cfg.ckpt_rows}, {cfg.row_dim}] layout")
+        self = cls(cfg, _init=False)
+        self.table = arr
+        return self
+
+    @classmethod
     def from_checkpoint(cls, cfg: FmConfig,
                         with_acc: bool = True) -> "HostOffloadLookup":
         """Restore straight into host RAM. The template's abstract

@@ -251,6 +251,67 @@ def global_batch(mesh: Mesh, local_uniq_size: int, **arrays) -> dict:
     return out
 
 
+def local_rows(global_arr: jax.Array) -> np.ndarray:
+    """This process's rows of a ``P('data')``-sharded global dim-0 array
+    (the output side of ``global_batch``): addressable shards ordered by
+    index range and deduplicated — with ``model_axis > 1`` the vector is
+    replicated along the model axis, so a process can hold several
+    shards covering the SAME range; keeping one per range is required or
+    the concat doubles the slice. Used by distributed validation and
+    multi-process predict to recover the local batch's slice."""
+    seen = set()
+    pieces = []
+    for s in sorted(global_arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0):
+        rng_key = (s.index[0].start, s.index[0].stop)
+        if rng_key in seen:
+            continue
+        seen.add(rng_key)
+        pieces.append(np.asarray(s.data))
+    return np.concatenate(pieces)
+
+
+def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
+                           table, uniq_bucket: int,
+                           max_batches: Optional[int] = None):
+    """Drive a per-process batch iterator through a mesh score fn in
+    LOCKSTEP: every score call is a collective program, so a process
+    whose shard ran dry (or hit ``max_batches`` real batches) feeds
+    all-padding filler until every process is done. Yields
+    ``(batch, local_scores)`` per local iterator batch — the single
+    implementation of the deadlock-sensitive protocol shared by
+    distributed validation and multi-process predict (a diverging copy
+    here hangs a cluster, not a test)."""
+    from jax.experimental import multihost_utils
+    from fast_tffm_tpu.data.pipeline import empty_batch
+    from fast_tffm_tpu.models.fm import batch_args
+    n_real = 0
+    while True:
+        done = bool(max_batches and n_real >= max_batches)
+        batch = None if done else next(it, None)
+        flags = multihost_utils.process_allgather(
+            np.asarray([batch is None]))
+        if bool(flags.all()):
+            return
+        filler = batch is None
+        if filler:
+            batch = empty_batch(cfg, uniq_bucket=uniq_bucket)
+        else:
+            n_real += 1
+        args = batch_args(batch)
+        args.pop("labels"), args.pop("weights")
+        gargs = global_batch(mesh, len(batch.uniq_ids), **args)
+        # This process's rows of the global [B_global] score vector are
+        # exactly its local batch (global_batch concatenates local
+        # batches in process order over process-contiguous data-axis
+        # devices); local_rows dedups model-axis replicas.
+        local = local_rows(score_fn(table, **gargs))
+        assert len(local) == len(batch.labels), (
+            f"local score slice {len(local)} != local batch "
+            f"{len(batch.labels)}")
+        yield batch, local
+
+
 def shard_batch(mesh: Mesh, **arrays) -> dict:
     """Place host batch arrays with their mesh shardings (keeps per-step
     host->device transfers going straight to the right shards)."""

@@ -72,23 +72,44 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
             else np.zeros(0, dtype=np.float32))
 
 
-def predict(cfg: FmConfig, table: Optional[jax.Array] = None) -> List[str]:
+def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
+            job_name: Optional[str] = None,
+            task_index: Optional[int] = None) -> List[str]:
     """Run batch prediction; returns the list of score files written.
 
     Multi-device hosts score through the mesh (row-sharded table +
     data-sharded batches — SURVEY.md §3.4's single restore+score stack,
     scaled the same way training is); a lone device gets the plain
-    jitted scorer."""
+    jitted scorer. ``dist_train worker <i>`` argv (mirroring the train
+    CLI) joins a jax.distributed job: input is byte-range-sharded by
+    process, scored in lockstep through the global mesh, and the chief
+    merges per-process part files into the ordered score file (a shared
+    ``score_path`` filesystem is assumed, as for checkpoints)."""
     logger = get_logger(log_file=cfg.log_file or None)
+    if job_name is not None:
+        from fast_tffm_tpu.parallel.distributed import init_from_cluster
+        init_from_cluster(cfg, job_name, task_index or 0)
+    if jax.process_count() > 1:
+        if cfg.lookup == "host":
+            raise ValueError("lookup = host predict is single-process")
+        return _predict_multiprocess(cfg, table, logger)
     mesh = None
     backend = None
-    if cfg.lookup == "host" and table is None:
-        # Offload predict (lookup.py seam): restore straight into host
-        # RAM; the device only ever sees per-batch [U, D] row blocks.
+    if cfg.lookup == "host":
+        # Offload predict (lookup.py seam): restore (or wrap a
+        # caller-supplied table) straight into host RAM; the device only
+        # ever sees per-batch [U, D] row blocks. Routing a provided
+        # table to the device paths here would materialize the
+        # offload-scale table in HBM — the exact OOM this mode avoids.
         from fast_tffm_tpu.lookup import HostOffloadLookup
-        backend = HostOffloadLookup.from_checkpoint(cfg, with_acc=False)
+        if table is None:
+            backend = HostOffloadLookup.from_checkpoint(cfg,
+                                                        with_acc=False)
+        else:
+            backend = HostOffloadLookup.for_table(cfg, table)
+            table = None
         logger.info("host-offload predict: table [%d, %d] in host RAM",
-                    backend.rows, backend.dim)
+                    *backend.table.shape)
     elif jax.device_count() > 1:
         from fast_tffm_tpu.parallel.sharded import make_mesh, place_table
         try:
@@ -123,5 +144,73 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None) -> List[str]:
             for v in vals:
                 fh.write(f"{v:.6f}\n")
         logger.info("wrote %d scores to %s", len(vals), out_path)
+        written.append(out_path)
+    return written
+
+
+def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
+    """Sharded predict: every process scores its byte-range input shard
+    through the global-mesh score fn in lockstep (each call is a
+    collective program — the filler-batch protocol from distributed
+    validation keeps uneven shards from deadlocking), writes its ordered
+    part file, and the chief concatenates parts in process order (byte
+    ranges are contiguous: process i's lines all precede process
+    i+1's)."""
+    from jax.experimental import multihost_utils
+    from fast_tffm_tpu.data.pipeline import (probe_uniq_bucket,
+                                             require_bounded_examples)
+    from fast_tffm_tpu.parallel.sharded import (lockstep_score_batches,
+                                                make_mesh,
+                                                make_sharded_score_fn)
+    require_bounded_examples(cfg, "multi-process predict")
+    mesh = make_mesh()
+    if cfg.batch_size % mesh.shape["data"]:
+        raise ValueError(
+            f"batch_size {cfg.batch_size} must be divisible by the mesh "
+            f"data axis {mesh.shape['data']} for multi-process predict")
+    logger.info("multi-process predict: %s over %d devices, %d processes",
+                dict(mesh.shape), jax.device_count(), jax.process_count())
+    if table is None:
+        table = load_table(cfg, mesh)
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_sharded_score_fn(spec, mesh)
+    p, P = jax.process_index(), jax.process_count()
+    os.makedirs(cfg.score_path, exist_ok=True)
+    written: List[str] = []
+    for path in expand_files(cfg.predict_files):
+        # Deterministic probe: every process reads the same bytes, so
+        # all agree on U without a collective.
+        ub = cfg.uniq_bucket or probe_uniq_bucket(cfg, [path])
+        it = batch_iterator(cfg, [path], training=False, epochs=1,
+                            keep_empty=True, shard_index=p, num_shards=P,
+                            fixed_shape=True, uniq_bucket=ub)
+        local: List[np.ndarray] = []
+        for batch, scores in lockstep_score_batches(cfg, it, mesh,
+                                                    score_fn, table, ub):
+            local.append(scores[:batch.num_real])
+        raw = (np.concatenate(local) if local
+               else np.zeros(0, dtype=np.float32))
+        vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
+        out_path = os.path.join(cfg.score_path,
+                                os.path.basename(path) + ".score")
+        part = f"{out_path}.part{p}"
+        with open(part, "w") as fh:
+            for v in vals:
+                fh.write(f"{v:.6f}\n")
+        tag = os.path.basename(path)
+        multihost_utils.sync_global_devices(f"predict_parts_{tag}")
+        if p == 0:
+            n = 0
+            with open(out_path, "w") as out_fh:
+                for i in range(P):
+                    with open(f"{out_path}.part{i}") as fh:
+                        data = fh.read()
+                    n += data.count("\n")
+                    out_fh.write(data)
+            logger.info("wrote %d scores to %s (merged %d parts)",
+                        n, out_path, P)
+        # Chief must finish reading every part before anyone deletes.
+        multihost_utils.sync_global_devices(f"predict_merged_{tag}")
+        os.remove(part)
         written.append(out_path)
     return written

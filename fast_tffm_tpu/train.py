@@ -21,7 +21,8 @@ import numpy as np
 
 from fast_tffm_tpu.checkpoint import CheckpointState, export_npz
 from fast_tffm_tpu.config import FmConfig
-from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
+from fast_tffm_tpu.data.pipeline import (SPILL_WARN_FRACTION, SpillStats,
+                                         batch_iterator, prefetch)
 from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
@@ -41,6 +42,7 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
     auc = StreamingAUC()
     n = 0
+    n_batches = 0
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1)):
         args = batch_args(batch)
@@ -48,28 +50,33 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
         scores = score_fn(table, args)
         auc.update(scores[:batch.num_real], batch.labels[:batch.num_real])
         n += batch.num_real
-        if max_batches and n >= max_batches * cfg.batch_size:
+        n_batches += 1
+        # Batch-count cap — the same per-input-shard unit the
+        # distributed path uses, so AUC samples are comparable.
+        if max_batches and n_batches >= max_batches:
             break
     return auc.result(), n
 
 
 def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                          shard_index: int, num_shards: int,
-                         uniq_bucket: int = 0) -> Tuple[float, int]:
+                         uniq_bucket: int = 0,
+                         max_batches: Optional[int] = None
+                         ) -> Tuple[float, int]:
     """Multi-process sharded AUC: every process scores its own input
-    shard through the mesh score fn in lockstep (each call is a
-    collective program), then the per-process binned-AUC histograms are
-    allgathered and merged — no table or score set ever materializes on
-    one host. Returns the same (auc, n_examples) on every process.
+    shard through the mesh score fn in lockstep (the shared
+    lockstep_score_batches protocol), then the per-process binned-AUC
+    histograms are allgathered and merged — no table or score set ever
+    materializes on one host. Returns the same (auc, n_examples) on
+    every process. ``max_batches`` caps real batches per input shard.
 
     ``uniq_bucket``: pass the caller's once-probed value; 0 re-probes
     (deterministic — same bytes on every process, so all agree without
     a collective)."""
     import numpy as np
     from jax.experimental import multihost_utils
-    from fast_tffm_tpu.data.pipeline import (empty_batch,
-                                             probe_uniq_bucket)
-    from fast_tffm_tpu.parallel.sharded import (global_batch,
+    from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
+    from fast_tffm_tpu.parallel.sharded import (lockstep_score_batches,
                                                 make_sharded_score_fn)
     spec = ModelSpec.from_config(cfg)
     score_fn = make_sharded_score_fn(spec, mesh)
@@ -79,28 +86,9 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
     it = batch_iterator(cfg, files, training=False, epochs=1,
                         shard_index=shard_index, num_shards=num_shards,
                         fixed_shape=True, uniq_bucket=ub)
-    while True:
-        batch = next(it, None)
-        flags = multihost_utils.process_allgather(
-            np.asarray([batch is None]))
-        if bool(flags.all()):
-            break
-        if batch is None:
-            batch = empty_batch(cfg, uniq_bucket=ub)
-        args = batch_args(batch)
-        args.pop("labels"), args.pop("weights")
-        gargs = global_batch(mesh, len(batch.uniq_ids), **args)
-        scores = score_fn(table, **gargs)
-        # This process's rows of the global [B_global] score vector are
-        # exactly its local batch (global_batch concatenates local
-        # batches in process order over process-contiguous data-axis
-        # devices); reassemble them in index order.
-        shards = sorted(scores.addressable_shards,
-                        key=lambda s: s.index[0].start or 0)
-        local = np.concatenate([np.asarray(s.data) for s in shards])
-        assert len(local) == len(batch.labels), (
-            f"local score slice {len(local)} != local batch "
-            f"{len(batch.labels)}")
+    for batch, local in lockstep_score_batches(cfg, it, mesh, score_fn,
+                                               table, ub,
+                                               max_batches=max_batches):
         auc.update(local[:batch.num_real], batch.labels[:batch.num_real])
         n += batch.num_real
     hists = multihost_utils.process_allgather(
@@ -154,17 +142,9 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     dict(mesh.shape), jax.device_count(),
                     jax.process_count())
 
-    if multi_process and not (
-            0 < cfg.max_features_per_example <= cfg.bucket_ladder[-1]):
-        # fixed_shape batches cap L at the ladder top; catching an
-        # over-long example lazily mid-run would kill one worker between
-        # collectives and hang its peers, so refuse up front. 0 means
-        # "unlimited", which can never be honored under a fixed L.
-        raise ValueError(
-            f"multi-process training needs 0 < max_features_per_example "
-            f"({cfg.max_features_per_example}) <= bucket_ladder max "
-            f"({cfg.bucket_ladder[-1]}) so over-long examples are "
-            "truncated up front instead of faulting one worker mid-run")
+    if multi_process:
+        from fast_tffm_tpu.data.pipeline import require_bounded_examples
+        require_bounded_examples(cfg, "multi-process training")
 
     uniq_bucket = 0
     if multi_process:
@@ -289,11 +269,13 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         for epoch in range(cfg.epoch_num):
             if stopping:
                 break
+            epoch_stats = SpillStats()
             it = prefetch(batch_iterator(
                 cfg, cfg.train_files, training=True,
                 weight_files=cfg.weight_files, shard_index=shard_index,
                 num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
-                fixed_shape=multi_process, uniq_bucket=uniq_bucket))
+                fixed_shape=multi_process, uniq_bucket=uniq_bucket,
+                stats=epoch_stats))
             while True:
                 batch = next(it, None)
                 if multi_process:
@@ -354,14 +336,30 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     ckpt.save(global_step, *state,
                               vocabulary_size=cfg.vocabulary_size,
                               wait=offload)
+            if epoch_stats.spilled_batches or (multi_process
+                                               and epoch_stats.batches):
+                # Spill visibility (fixed-U mode): a probe-missed dense
+                # stretch degrades fill silently otherwise.
+                logger.info("epoch %d input: %s", epoch,
+                            epoch_stats.describe())
+                if epoch_stats.spill_fraction > SPILL_WARN_FRACTION:
+                    logger.warning(
+                        "uniq_bucket %d is undersized for this data: "
+                        "%.0f%% of batches closed early on the "
+                        "unique-row budget; raise uniq_bucket (or set 0 "
+                        "to re-probe) to recover effective batch size",
+                        uniq_bucket, 100 * epoch_stats.spill_fraction)
             if cfg.validation_files and not stopping:
+                vmb = cfg.validation_max_batches or None
                 if multi_process:
                     auc, n = evaluate_distributed(
                         cfg, table, cfg.validation_files, mesh,
-                        shard_index, num_shards, uniq_bucket=val_bucket)
+                        shard_index, num_shards, uniq_bucket=val_bucket,
+                        max_batches=vmb)
                 else:
                     auc, n = evaluate(cfg, table, cfg.validation_files,
-                                      mesh=mesh, backend=lk)
+                                      mesh=mesh, backend=lk,
+                                      max_batches=vmb)
                 last_val = (auc, n)
                 if jax.process_index() == 0:
                     logger.info(

@@ -5,11 +5,15 @@
     python run_tffm.py train   <cfg>
     python run_tffm.py train   <cfg> dist_train <job_name> <task_index>
     python run_tffm.py predict <cfg>
+    python run_tffm.py predict <cfg> dist_train <job_name> <task_index>
 
 ``dist_train`` roles map onto synchronous jax.distributed processes
 instead of TF1 ps/worker async-SGD (SURVEY §7): ``worker i`` becomes DP
 process i; a ``ps`` role is accepted and exits with an explanatory
 message, since parameter serving is subsumed by the row-sharded table.
+``predict ... dist_train`` (an extension: the reference predicts
+single-process) shards the predict input across the same worker
+cluster and merges ordered score files on the chief.
 """
 
 from __future__ import annotations
@@ -32,13 +36,6 @@ def main(argv=None) -> int:
     rest = argv[2:]
     cfg = load_config(cfg_path)
 
-    if mode == "predict":
-        if rest:
-            return _usage()
-        from fast_tffm_tpu.predict import predict
-        predict(cfg)
-        return 0
-
     job_name = task_index = None
     if rest:
         if len(rest) != 3 or rest[0] != "dist_train":
@@ -51,6 +48,11 @@ def main(argv=None) -> int:
             return 0
         if job_name != "worker":
             return _usage()
+
+    if mode == "predict":
+        from fast_tffm_tpu.predict import predict
+        predict(cfg, job_name=job_name, task_index=task_index)
+        return 0
 
     from fast_tffm_tpu.train import train
     train(cfg, job_name, task_index)

@@ -85,3 +85,29 @@ profile_num_steps = 3
     for root, _, files in os.walk(prof):
         dumped += files
     assert dumped, "no profiler trace files written"
+
+
+def test_validation_max_batches_caps_eval(tmp_path, rng):
+    """validation_max_batches bounds the per-epoch validation sweep
+    (full Criteo-scale validation every epoch is a whole extra data
+    pass); the final AUC still logs over the capped sample."""
+    from tests.test_e2e import make_dataset
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.train import evaluate, train
+    from fast_tffm_tpu.models.fm import init_table
+    make_dataset(tmp_path / "train.txt", 64, rng)
+    make_dataset(tmp_path / "val.txt", 320, rng)
+    cfg = FmConfig(vocabulary_size=200, factor_num=4, batch_size=32,
+                   epoch_num=1, shuffle=False,
+                   train_files=(str(tmp_path / "train.txt"),),
+                   validation_files=(str(tmp_path / "val.txt"),),
+                   validation_max_batches=2,
+                   model_file=str(tmp_path / "m" / "fm"),
+                   log_file=str(tmp_path / "fm.log"))
+    _, n = evaluate(cfg, init_table(cfg), cfg.validation_files,
+                    max_batches=2)
+    assert n == 64  # 2 batches x 32, not all 320
+    train(cfg)
+    log = (tmp_path / "fm.log").read_text()
+    assert "validation AUC" in log
+    assert "over 64 examples" in log

@@ -149,6 +149,27 @@ def test_from_checkpoint_table_only(host_cfg_files):
     assert lean.step == full.step
 
 
+def test_predict_with_caller_table_stays_host_side(host_cfg_files):
+    """predict(cfg, table=...) under lookup=host must wrap the provided
+    host table in the backend (for_table), not ship it to a device."""
+    tmp_path, cfg_path, _ = host_cfg_files
+    assert run_tffm.main(["train", str(cfg_path)]) == 0
+    cfg = load_config(str(cfg_path))
+    from fast_tffm_tpu.train import train as _train  # table from train()
+    import dataclasses
+    from fast_tffm_tpu.predict import predict
+    table = HostOffloadLookup.from_checkpoint(cfg).table[:cfg.num_rows]
+    cfg2 = dataclasses.replace(cfg,
+                               score_path=str(tmp_path / "score_t"))
+    predict(cfg2, table=table)
+    s1 = np.loadtxt(tmp_path / "score_t" / "test.txt.score")
+    predict(cfg)  # checkpoint path
+    s2 = np.loadtxt(tmp_path / "score" / "test.txt.score")
+    np.testing.assert_allclose(s1, s2, atol=1e-6)
+    with pytest.raises(ValueError, match="layout"):
+        HostOffloadLookup.for_table(cfg, np.zeros((5, 5), np.float32))
+
+
 def test_host_lookup_rejects_multiprocess(tmp_path, rng, monkeypatch):
     make_dataset(tmp_path / "train.txt", 50, rng)
     cfg = _cfg(tmp_path, lookup="host")

@@ -48,23 +48,7 @@ worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
 
 
 def _launch(cfg_path):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("XLA_FLAGS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "run_tffm.py", "train", str(cfg_path),
-             "dist_train", "worker", str(i)],
-            cwd=REPO, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True)
-        for i in range(2)
-    ]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} failed:\n{out}"
-    return outs
+    return _launch_mode(cfg_path, "train")
 
 
 @pytest.mark.slow
@@ -108,3 +92,158 @@ def test_two_worker_dist_train_and_resume(tmp_path):
         outs2[0][-2000:])
     assert any("training done" in o for o in outs2)
     assert sum("epoch 2 validation AUC" in o for o in outs2) == 1
+
+
+def _launch_mode(cfg_path, mode):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "run_tffm.py", mode, str(cfg_path),
+             "dist_train", "worker", str(i)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+    return outs
+
+
+@pytest.mark.slow
+def test_two_worker_dist_predict_matches_single(tmp_path):
+    """2-process sharded predict must write the same ordered score file
+    a single-process predict writes from the same checkpoint — blank
+    lines (line-alignment) included."""
+    rng = np.random.default_rng(1)
+    lines = []
+    for _ in range(150):
+        nnz = rng.integers(2, 10)
+        ids = rng.choice(128, size=nnz, replace=False)
+        lines.append(" ".join(["1" if rng.random() < 0.5 else "0"]
+                              + [f"{i}:{rng.random():.3f}" for i in ids]))
+    data = tmp_path / "train.txt"
+    data.write_text("\n".join(lines) + "\n")
+    pred = tmp_path / "pred.txt"
+    pred_lines = lines[:70] + [""] + lines[70:110]   # blank line kept
+    pred.write_text("\n".join(pred_lines) + "\n")
+
+    model = tmp_path / "model" / "fm"
+    coord = _free_port()
+    cfg = tmp_path / "dist.cfg"
+    cfg.write_text(f"""
+[General]
+vocabulary_size = 128
+factor_num = 4
+model_file = {model}
+
+[Train]
+train_files = {data}
+epoch_num = 1
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+max_features_per_example = 16
+bucket_ladder = 16
+
+[Predict]
+predict_files = {pred}
+score_path = {tmp_path}/score
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+    # 2-process train writes the shared checkpoint...
+    _launch_mode(cfg, "train")
+    # ...then 2-process sharded predict from it.
+    outs = _launch_mode(cfg, "predict")
+    assert any("multi-process predict" in o for o in outs), outs[0][-2000:]
+    assert sum("merged 2 parts" in o for o in outs) == 1
+    score_file = tmp_path / "score" / "pred.txt.score"
+    scores_mp = np.loadtxt(score_file)
+    assert len(scores_mp) == len(pred_lines)   # one per line, blanks too
+    assert not list((tmp_path / "score").glob("*.part*"))
+
+    # Single-process predict from the same checkpoint (in-process, on
+    # the 8-device CPU mesh) must agree to float-print precision.
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.predict import predict
+    import dataclasses
+    sp_cfg = dataclasses.replace(load_config(str(cfg)),
+                                 score_path=str(tmp_path / "score_sp"))
+    predict(sp_cfg)
+    scores_sp = np.loadtxt(tmp_path / "score_sp" / "pred.txt.score")
+    np.testing.assert_allclose(scores_mp, scores_sp, atol=2e-6)
+
+
+@pytest.mark.slow
+def test_two_process_adagrad_convergence_parity(tmp_path):
+    """The documented multi-process Adagrad divergence (an id hot on
+    several processes accumulates sum-of-per-process g^2 instead of
+    (sum g)^2 — parallel/sharded.py global_batch) must not cost
+    convergence: 2-process and 1-process training on the same data must
+    reach the same test AUC within a small tolerance."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_e2e import make_dataset
+    rng = np.random.default_rng(7)
+    data = tmp_path / "train.txt"
+    test = tmp_path / "test.txt"
+    make_dataset(data, 600, rng)
+    test_labels = make_dataset(test, 200, rng)
+
+    model_mp = tmp_path / "mmp" / "fm"
+    coord = _free_port()
+    cfg = tmp_path / "par.cfg"
+
+    def write_cfg(model, cluster):
+        cfg.write_text(f"""
+[General]
+vocabulary_size = 200
+factor_num = 4
+model_file = {model}
+
+[Train]
+train_files = {data}
+epoch_num = 6
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+max_features_per_example = 16
+bucket_ladder = 16
+{cluster}
+""")
+
+    write_cfg(model_mp, f"""
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+    _launch_mode(cfg, "train")
+    table_mp = np.load(str(model_mp) + ".npz")["table"]
+
+    model_sp = tmp_path / "msp" / "fm"
+    write_cfg(model_sp, "")
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.train import train
+    train(load_config(str(cfg)))
+    table_sp = np.load(str(model_sp) + ".npz")["table"]
+
+    from fast_tffm_tpu.metrics import exact_auc
+    from fast_tffm_tpu.models.oracle import fm_score
+    from fast_tffm_tpu.data.parser import parse_lines
+
+    def auc_of(table):
+        block = parse_lines(test.read_text().splitlines(), 200)
+        scores = [fm_score(table,
+                           block.ids[block.poses[i]:block.poses[i + 1]],
+                           block.vals[block.poses[i]:block.poses[i + 1]])
+                  for i in range(block.batch_size)]
+        return exact_auc(np.asarray(scores), test_labels)
+
+    auc_sp, auc_mp = auc_of(table_sp), auc_of(table_mp)
+    assert auc_sp > 0.85, auc_sp
+    assert abs(auc_sp - auc_mp) < 0.03, (auc_sp, auc_mp)
