@@ -1,15 +1,34 @@
-"""Benchmark: end-to-end training throughput on the flagship FM config.
+"""Benchmark: end-to-end training throughput on the flagship FM config,
+with an attributable breakdown.
 
 Mirrors BASELINE config #1 shapes (2nd-order FM, k=8, Criteo-Kaggle-like
 data: ~39 features/example, 1M-row hash space) on whatever single device
 is present (the driver runs this on one real TPU chip).
 
-Measures the full training loop — host text parsing (C++ parser), batch
-building/dedup, host->device transfer, and the jitted train step — i.e.
-the same end-to-end examples/sec the reference's `sess.run` loop measures.
+The headline metric is the median of ``TRIALS`` end-to-end runs of the
+full training loop — host text parsing (C++ parser), batch building/
+dedup, host->device transfer, and the jitted train step — i.e. the same
+end-to-end examples/sec the reference's ``sess.run`` loop measures.
+Because one tunnelled-TPU number proved undiagnosable when it moved
+between rounds, the same JSON carries the attribution breakdown:
+
+- ``e2e_trials``: every end-to-end trial (spread = environment noise),
+- ``host_only``: pipeline-only rate (file -> C++ parse -> dedup -> padded
+  batch, device never touched) — the input-bound ceiling,
+- ``device_only``: jitted-step rate on one cached resident batch (no host
+  work, no transfer) — the compute-bound ceiling,
+- ``h2d_only``: device_put rate for one batch's arrays (~4.3 MB/step) —
+  the transfer ceiling; on a tunnelled TPU this is the usual culprit,
+- ``sharded_input_per_worker``: host-only rate of ONE of 2 byte-range
+  shards (the multi-process fast path's per-worker input build),
+  recorded so the "sharded input ~matches unsharded" claim is an
+  artifact, not a commit message.
+
+Whichever of host_only/device_only sits near the e2e number names the
+bottleneck; a regression that moves e2e but neither ceiling is noise.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...}
 
 vs_baseline: BASELINE.json publishes no reference numbers ("published":
 {}); the only stated target is the north star of 1e9 examples/hour on a
@@ -19,11 +38,16 @@ the north-star rate.
 """
 
 import json
+import statistics
 import time
 
 import numpy as np
 
 NORTH_STAR_PER_CHIP = 1e9 / 3600.0 / 64.0  # examples/sec/chip
+
+B = 8192
+N_WARM, N_TIMED = 4, 40
+TRIALS = 3
 
 
 def synth_lines(n, vocab, seed=0):
@@ -43,59 +67,124 @@ def synth_lines(n, vocab, seed=0):
     return lines
 
 
+def make_cfg(path):
+    from fast_tffm_tpu.config import FmConfig
+    return FmConfig(vocabulary_size=1 << 20, factor_num=8, batch_size=B,
+                    learning_rate=0.05, factor_lambda=1e-6,
+                    bias_lambda=1e-6, max_features_per_example=64,
+                    bucket_ladder=(64,), train_files=(path,),
+                    shuffle=False)
+
+
+def run_e2e(cfg, step):
+    """One honest end-to-end trial: file -> C++ parse -> dedup/pad -> H2D
+    -> jitted step, host pipeline prefetching ahead of the device (the
+    same loop train() runs)."""
+    import jax
+    from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
+    from fast_tffm_tpu.models.fm import (batch_args, init_accumulator,
+                                         init_table)
+    table = init_table(cfg, 0)
+    acc = init_accumulator(cfg)
+    it = prefetch(batch_iterator(cfg, cfg.train_files, training=True),
+                  depth=4)
+    t0 = None
+    n = 0
+    for batch in it:
+        table, acc, loss, _ = step(table, acc, **batch_args(batch))
+        n += 1
+        if n == N_WARM:  # compile + cache warm; start the clock
+            jax.block_until_ready((table, acc))
+            t0 = time.perf_counter()
+    jax.block_until_ready((table, acc))
+    return (n - N_WARM) * B / (time.perf_counter() - t0)
+
+
+def run_host_only(cfg, shard_index=0, num_shards=1):
+    """Pipeline-only rate: consume every batch, never touch the device."""
+    from fast_tffm_tpu.data.pipeline import batch_iterator
+    n_ex = 0
+    t0 = time.perf_counter()
+    for batch in batch_iterator(cfg, cfg.train_files, training=True,
+                                shard_index=shard_index,
+                                num_shards=num_shards):
+        n_ex += batch.num_real
+    return n_ex / (time.perf_counter() - t0)
+
+
+def run_device_only(cfg, step):
+    """Jitted-step rate on one device-resident batch: no host pipeline,
+    no transfer. The batch args are device arrays reused every call
+    (table/acc are donated and threaded through)."""
+    import jax
+    from fast_tffm_tpu.data.pipeline import batch_iterator
+    from fast_tffm_tpu.models.fm import (batch_args, init_accumulator,
+                                         init_table)
+    batch = next(batch_iterator(cfg, cfg.train_files, training=True))
+    args = {k: jax.device_put(v) for k, v in batch_args(batch).items()}
+    table = init_table(cfg, 0)
+    acc = init_accumulator(cfg)
+    for _ in range(N_WARM):
+        table, acc, loss, _ = step(table, acc, **args)
+    jax.block_until_ready((table, acc))
+    t0 = time.perf_counter()
+    for _ in range(N_TIMED):
+        table, acc, loss, _ = step(table, acc, **args)
+    jax.block_until_ready((table, acc))
+    return N_TIMED * B / (time.perf_counter() - t0)
+
+
+def run_h2d_only(cfg):
+    """Transfer-only rate: device_put one batch's host arrays per step
+    (the per-step H2D traffic, ~4.3 MB at these shapes), nothing else."""
+    import jax
+    from fast_tffm_tpu.data.pipeline import batch_iterator
+    from fast_tffm_tpu.models.fm import batch_args
+    batch = next(batch_iterator(cfg, cfg.train_files, training=True))
+    args = batch_args(batch)
+    jax.block_until_ready(jax.device_put(list(args.values())))
+    t0 = time.perf_counter()
+    for _ in range(N_TIMED):
+        jax.block_until_ready(jax.device_put(list(args.values())))
+    return N_TIMED * B / (time.perf_counter() - t0)
+
+
 def main():
     import os
     import tempfile
 
-    import jax
-    from fast_tffm_tpu.config import FmConfig
-    from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
-    from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
-                                         init_accumulator, init_table,
-                                         make_train_step)
-
-    B = 8192
-    n_warm, n_timed = 4, 40
+    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
 
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "train.txt")
-        lines = synth_lines((n_warm + n_timed) * B, 1 << 20)
+        lines = synth_lines((N_WARM + N_TIMED) * B, 1 << 20)
         with open(path, "w") as fh:
             fh.write("\n".join(lines) + "\n")
         del lines
 
-        cfg = FmConfig(vocabulary_size=1 << 20, factor_num=8, batch_size=B,
-                       learning_rate=0.05, factor_lambda=1e-6,
-                       bias_lambda=1e-6, max_features_per_example=64,
-                       bucket_ladder=(64,), train_files=(path,),
-                       shuffle=False)
+        cfg = make_cfg(path)
         spec = ModelSpec.from_config(cfg)
-        table = init_table(cfg, 0)
-        acc = init_accumulator(cfg)
         step = make_train_step(spec)
 
-        # Honest end-to-end: file -> C++ parse -> dedup/pad -> H2D -> jitted
-        # step, with the host pipeline prefetching ahead of the device (the
-        # same loop train() runs).
-        it = prefetch(batch_iterator(cfg, cfg.train_files, training=True),
-                      depth=4)
-        t0 = None
-        n = 0
-        for batch in it:
-            table, acc, loss, _ = step(table, acc, **batch_args(batch))
-            n += 1
-            if n == n_warm:  # compile + cache warm; start the clock
-                jax.block_until_ready((table, acc))
-                t0 = time.perf_counter()
-        jax.block_until_ready((table, acc))
-        dt = time.perf_counter() - t0
+        e2e = [run_e2e(cfg, step) for _ in range(TRIALS)]
+        host = run_host_only(cfg)
+        dev = run_device_only(cfg, step)
+        h2d = run_h2d_only(cfg)
+        # Per-worker input rate of the 2-way byte-range sharded fast path
+        # (what each process's pipeline sustains in multi-process mode).
+        shard = run_host_only(cfg, shard_index=0, num_shards=2)
 
-    eps = n_timed * B / dt
+    eps = statistics.median(e2e)
     print(json.dumps({
         "metric": "train_examples_per_sec_per_chip",
         "value": round(eps, 1),
         "unit": "examples/sec",
         "vs_baseline": round(eps / NORTH_STAR_PER_CHIP, 3),
+        "e2e_trials": [round(v, 1) for v in e2e],
+        "host_only": round(host, 1),
+        "device_only": round(dev, 1),
+        "h2d_only": round(h2d, 1),
+        "sharded_input_per_worker": round(shard, 1),
     }))
 
 

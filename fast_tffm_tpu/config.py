@@ -133,6 +133,10 @@ class FmConfig:
             raise ValueError(
                 f"uniq_bucket must be 0 (auto) or a power of two >= 64 "
                 f"(mesh sharding divides the unique axis), got {ub}")
+        if self.validation_max_batches < 0:
+            raise ValueError(
+                f"validation_max_batches must be >= 0 (0 = full sweep), "
+                f"got {self.validation_max_batches}")
         if ub and self.max_features_per_example >= ub:
             raise ValueError(
                 f"uniq_bucket ({ub}) must exceed max_features_per_example "

@@ -604,15 +604,18 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
     got_lines = False
     for start in sorted({0, size // 3, 2 * size // 3}):
         lines: List[str] = []
-        buf = ""
+        buf = b""
+        # Split on newlines BEFORE decoding: a multibyte UTF-8 character
+        # straddling a chunk boundary must reach the parser intact (the
+        # hash of a mangled token would drift from what real batches see).
         for chunk in _iter_owned_chunks(files[0], start, size):
-            parts = (buf + chunk.decode("utf-8", "replace")).split("\n")
+            parts = (buf + chunk).split(b"\n")
             buf = parts.pop()
-            lines.extend(l for l in parts if l.strip())
+            lines.extend(l.decode("utf-8") for l in parts if l.strip())
             if len(lines) >= B:
                 break
         if buf.strip() and len(lines) < B:
-            lines.append(buf)
+            lines.append(buf.decode("utf-8"))
         if not lines:
             continue
         got_lines = True
