@@ -84,7 +84,11 @@ class FmConfig:
     # training. 0 = auto: measured from the data at startup
     # (data/pipeline.probe_uniq_bucket). Overfull batches spill safely.
     uniq_bucket: int = 0
-    kernel: str = "xla"             # "xla" | "pallas"
+    # "auto" = the fused Pallas kernel where it applies (2nd-order FM on
+    # a TPU backend; measured ~3x the XLA step rate at bench shapes,
+    # README "Performance") and XLA everywhere else. Resolved once in
+    # ModelSpec.from_config.
+    kernel: str = "auto"            # "auto" | "xla" | "pallas"
     # Profiling (SURVEY §5 "Tracing": reference has none; we dump a
     # TensorBoard/Perfetto trace of a steady-state step window on demand):
     profile_dir: str = ""           # empty = profiling off
@@ -114,7 +118,7 @@ class FmConfig:
                 raise ValueError("ffm supports order=2 only")
         if self.loss_type not in ("logistic", "mse"):
             raise ValueError(f"unknown loss_type {self.loss_type!r}")
-        if self.kernel not in ("xla", "pallas"):
+        if self.kernel not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
         if self.lookup not in ("device", "host"):
             raise ValueError(f"unknown lookup {self.lookup!r}")

@@ -48,12 +48,20 @@ class ModelSpec:
 
     @classmethod
     def from_config(cls, cfg: FmConfig) -> "ModelSpec":
+        kernel = cfg.kernel
+        if kernel == "auto":
+            # Pallas wherever the fused kernel applies (2nd-order FM) and
+            # the backend can run it natively; interpret mode off-TPU is a
+            # correctness fallback, not a fast path, so auto stays XLA
+            # there.
+            kernel = ("pallas" if cfg.model_type == "fm" and cfg.order == 2
+                      and jax.default_backend() == "tpu" else "xla")
         return cls(model_type=cfg.model_type, order=cfg.order,
                    factor_num=cfg.factor_num, field_num=cfg.field_num,
                    vocabulary_size=cfg.vocabulary_size,
                    loss_type=cfg.loss_type, factor_lambda=cfg.factor_lambda,
                    bias_lambda=cfg.bias_lambda,
-                   learning_rate=cfg.learning_rate, kernel=cfg.kernel)
+                   learning_rate=cfg.learning_rate, kernel=kernel)
 
     @property
     def row_dim(self) -> int:
@@ -81,13 +89,17 @@ def init_accumulator(cfg: FmConfig) -> jax.Array:
 
 
 def _scores(spec: ModelSpec, gathered: jax.Array, local_idx: jax.Array,
-            vals: jax.Array, fields: Optional[jax.Array]) -> jax.Array:
+            vals: jax.Array, fields: Optional[jax.Array],
+            mesh=None) -> jax.Array:
+    """``mesh`` (sharded paths only) lets the Pallas kernel run under
+    shard_map over the data axis — GSPMD cannot partition a pallas_call
+    itself (parallel/sharded.py binds it; None = single-device jit)."""
     if spec.model_type == "ffm":
         return ffm_batch_scores(gathered, spec.field_num, local_idx,
                                 fields, vals)
     if spec.kernel == "pallas" and spec.order == 2:
         from fast_tffm_tpu.ops.pallas_fm import fm_batch_scores_pallas
-        return fm_batch_scores_pallas(gathered, local_idx, vals)
+        return fm_batch_scores_pallas(gathered, local_idx, vals, mesh=mesh)
     return fm_batch_scores(gathered, local_idx, vals, order=spec.order)
 
 
@@ -104,11 +116,11 @@ def _per_example_loss(spec: ModelSpec, scores: jax.Array,
 def loss_and_scores(spec: ModelSpec, gathered: jax.Array,
                     labels: jax.Array, weights: jax.Array,
                     uniq_ids: jax.Array, local_idx: jax.Array,
-                    vals: jax.Array, fields: Optional[jax.Array]
-                    ) -> Tuple[jax.Array, jax.Array]:
+                    vals: jax.Array, fields: Optional[jax.Array],
+                    mesh=None) -> Tuple[jax.Array, jax.Array]:
     """Weighted-mean data loss + batch-active L2 reg. Zero-weight padding
     examples drop out of both value and gradient."""
-    scores = _scores(spec, gathered, local_idx, vals, fields)
+    scores = _scores(spec, gathered, local_idx, vals, fields, mesh=mesh)
     per = _per_example_loss(spec, scores, labels)
     wsum = jnp.maximum(weights.sum(), 1.0)
     data_loss = (per * weights).sum() / wsum
@@ -132,7 +144,7 @@ def sparse_adagrad_apply(table: jax.Array, acc: jax.Array,
 
 
 def grad_body(spec: ModelSpec, gathered, labels, weights, uniq_ids,
-              local_idx, vals, fields=None):
+              local_idx, vals, fields=None, *, mesh=None):
     """The device-side compute between a lookup backend's ``gather`` and
     ``apply_grad`` (lookup.py): loss/scores plus gradients w.r.t. the
     gathered ``[U, D]`` rows, padding rows masked to zero.
@@ -146,7 +158,7 @@ def grad_body(spec: ModelSpec, gathered, labels, weights, uniq_ids,
     """
     def loss_fn(g):
         return loss_and_scores(spec, g, labels, weights, uniq_ids,
-                               local_idx, vals, fields)
+                               local_idx, vals, fields, mesh=mesh)
 
     (loss, scores), grad = jax.value_and_grad(
         loss_fn, has_aux=True)(gathered)
@@ -163,7 +175,7 @@ def make_grad_fn(spec: ModelSpec):
 
 
 def train_step_body(spec: ModelSpec, table, acc, labels, weights, uniq_ids,
-                    local_idx, vals, fields=None):
+                    local_idx, vals, fields=None, *, mesh=None):
     """One full training step (gather -> loss -> grad -> sparse Adagrad).
 
     Pure function of arrays; jitted directly by make_train_step and jitted
@@ -174,7 +186,8 @@ def train_step_body(spec: ModelSpec, table, acc, labels, weights, uniq_ids,
     """
     gathered = table[uniq_ids]
     loss, scores, grad = grad_body(spec, gathered, labels, weights,
-                                   uniq_ids, local_idx, vals, fields)
+                                   uniq_ids, local_idx, vals, fields,
+                                   mesh=mesh)
     table, acc = sparse_adagrad_apply(table, acc, uniq_ids, grad,
                                       spec.learning_rate)
     return table, acc, loss, scores
@@ -192,10 +205,10 @@ def make_train_step(spec: ModelSpec):
 
 
 def rows_score_body(spec: ModelSpec, gathered, local_idx, vals,
-                    fields=None):
+                    fields=None, *, mesh=None):
     """Inference forward from already-gathered rows — the score-side half
     of the lookup seam (offload predict: host gathers, device scores)."""
-    return _scores(spec, gathered, local_idx, vals, fields)
+    return _scores(spec, gathered, local_idx, vals, fields, mesh=mesh)
 
 
 @functools.lru_cache(maxsize=None)
@@ -206,12 +219,13 @@ def make_rows_score_fn(spec: ModelSpec):
 
 
 def score_body(spec: ModelSpec, table, uniq_ids, local_idx, vals,
-               fields=None):
+               fields=None, *, mesh=None):
     """Inference forward (gather -> scorer). Shared by the single-device
     and mesh-sharded score functions — single source of truth, like
     train_step_body."""
     gathered = table[uniq_ids]
-    return rows_score_body(spec, gathered, local_idx, vals, fields)
+    return rows_score_body(spec, gathered, local_idx, vals, fields,
+                           mesh=mesh)
 
 
 @functools.lru_cache(maxsize=None)

@@ -140,13 +140,31 @@ fm_scores_pallas.defvjp(_fm_fwd, _fm_bwd)
 
 
 def fm_batch_scores_pallas(params: jax.Array, local_idx: jax.Array,
-                           vals: jax.Array) -> jax.Array:
+                           vals: jax.Array, mesh=None) -> jax.Array:
     """Drop-in for ops.interaction.fm_batch_scores (order=2) with the
     interaction fused in Pallas. The [U, K+1] -> [B, L, K+1] gather (and
     its scatter-add transpose in the VJP) stays in XLA, which lowers
     both optimally; the kernel owns everything after the gather, in the
-    lane-friendly [B, K, L] layout."""
+    lane-friendly [B, K, L] layout.
+
+    ``mesh``: GSPMD has no partitioning rule for a ``pallas_call``, so
+    under a sharded jit the kernel is wrapped in ``shard_map`` over the
+    batch ("data") axis — each device runs the kernel on its batch
+    shard, zero collectives inside (the interaction is per-example).
+    The gather stays outside in GSPMD-land, which owns the row-shard
+    collectives. This is how kernel='pallas' survives the mesh paths
+    (parallel/sharded.py binds the mesh)."""
     rows = params[local_idx]
     v = jnp.swapaxes(rows[..., :-1], 1, 2)   # [B, K, L]
     w = rows[..., -1]
-    return fm_scores_pallas(v, w, vals)
+    if mesh is None:
+        return fm_scores_pallas(v, w, vals)
+    from jax.sharding import PartitionSpec as P
+    # check_vma=False: pallas_call declares no varying-mesh-axes rule;
+    # the body is per-example with zero collectives, so the manual specs
+    # are the whole contract.
+    fn = jax.shard_map(
+        fm_scores_pallas, mesh=mesh,
+        in_specs=(P("data", None, None), P("data", None), P("data", None)),
+        out_specs=P("data"), check_vma=False)
+    return fn(v, w, vals)

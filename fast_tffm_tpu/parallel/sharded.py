@@ -77,16 +77,12 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(grid, ("data", "model"))
 
 
-def _xla_kernel(spec: ModelSpec) -> ModelSpec:
-    """Mesh paths always use the XLA scorer: GSPMD has no partitioning
-    rule for a pallas_call custom call, so kernel='pallas' under the
-    sharded jit would either fail to lower or silently replicate the
-    batch onto every device. The XLA path fuses well under GSPMD; the
-    Pallas kernel is the single-device fast path."""
-    if spec.kernel == "xla":
-        return spec
-    import dataclasses
-    return dataclasses.replace(spec, kernel="xla")
+# kernel='pallas' on a mesh: GSPMD has no partitioning rule for a
+# pallas_call custom call, so the step bodies wrap the kernel in
+# shard_map over the data axis when given the mesh (models/fm._scores,
+# ops/pallas_fm.fm_batch_scores_pallas) — each device runs the fused
+# kernel on its batch shard, GSPMD keeps owning the gather/scatter
+# collectives around it. The mesh is bound into the partial below.
 
 
 def _layout(mesh: Mesh):
@@ -115,9 +111,8 @@ def make_sharded_train_step(spec: ModelSpec, mesh: Mesh,
     the whole mesh, loss replicated. Cached per (spec, mesh)."""
     if with_fields is None:
         with_fields = spec.model_type == "ffm"
-    spec = _xla_kernel(spec)
     in_sh, out_sh = _shardings(mesh, with_fields)
-    fn = functools.partial(train_step_body, spec)
+    fn = functools.partial(train_step_body, spec, mesh=mesh)
     jitted = jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh,
                      donate_argnums=(0, 1))
 
@@ -139,11 +134,10 @@ def make_sharded_score_fn(spec: ModelSpec, mesh: Mesh,
     """Sharded inference: row-sharded table in, batch-sharded scores out."""
     if with_fields is None:
         with_fields = spec.model_type == "ffm"
-    spec = _xla_kernel(spec)
     row, vec, mat, _ = _layout(mesh)
     in_sh = [row, vec, mat, mat] + ([mat] if with_fields else [])
 
-    jitted = jax.jit(functools.partial(score_body, spec),
+    jitted = jax.jit(functools.partial(score_body, spec, mesh=mesh),
                      in_shardings=tuple(in_sh), out_shardings=vec)
 
     def score(table, uniq_ids, local_idx, vals, fields=None):
