@@ -22,7 +22,10 @@ between rounds, the same JSON carries the attribution breakdown:
 - ``sharded_input_per_worker``: host-only rate of ONE of 2 byte-range
   shards (the multi-process fast path's per-worker input build),
   recorded so the "sharded input ~matches unsharded" claim is an
-  artifact, not a commit message.
+  artifact, not a commit message,
+- ``ffm_e2e``: end-to-end rate of the field-aware model (BASELINE
+  config #3 shapes: Avazu-like ~24 fields, k=4) through the same C++
+  fast path — FFM's own bench line.
 
 Whichever of host_only/device_only sits near the e2e number names the
 bottleneck; a regression that moves e2e but neither ceiling is noise.
@@ -76,10 +79,11 @@ def make_cfg(path):
                     shuffle=False)
 
 
-def run_e2e(cfg, step):
+def run_e2e(cfg, step, n_warm=N_WARM):
     """One honest end-to-end trial: file -> C++ parse -> dedup/pad -> H2D
     -> jitted step, host pipeline prefetching ahead of the device (the
-    same loop train() runs)."""
+    same loop train() runs). One timing protocol for every e2e line
+    (FM headline and FFM)."""
     import jax
     from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
     from fast_tffm_tpu.models.fm import (batch_args, init_accumulator,
@@ -93,11 +97,11 @@ def run_e2e(cfg, step):
     for batch in it:
         table, acc, loss, _ = step(table, acc, **batch_args(batch))
         n += 1
-        if n == N_WARM:  # compile + cache warm; start the clock
+        if n == n_warm:  # compile + cache warm; start the clock
             jax.block_until_ready((table, acc))
             t0 = time.perf_counter()
     jax.block_until_ready((table, acc))
-    return (n - N_WARM) * B / (time.perf_counter() - t0)
+    return (n - n_warm) * cfg.batch_size / (time.perf_counter() - t0)
 
 
 def run_host_only(cfg, shard_index=0, num_shards=1):
@@ -132,6 +136,38 @@ def run_device_only(cfg, step):
         table, acc, loss, _ = step(table, acc, **args)
     jax.block_until_ready((table, acc))
     return N_TIMED * B / (time.perf_counter() - t0)
+
+
+def synth_ffm_lines(n, vocab, field_num=24, seed=0):
+    """Avazu-like FFM lines: one categorical feature per field."""
+    rng = np.random.default_rng(seed)
+    labels = (rng.random(n) < 0.17).astype(np.int32)
+    ids = rng.integers(0, vocab, size=(n, field_num))
+    lines = []
+    for i in range(n):
+        toks = [f"{f}:{ids[i, f]}" for f in range(field_num)]
+        lines.append(" ".join([str(labels[i])] + toks))
+    return lines
+
+
+def run_ffm_e2e(tmp):
+    """One compact FFM end-to-end trial (config #3 shapes), same timing
+    protocol as the headline (run_e2e)."""
+    import os
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
+    B_ffm, n_warm, n_timed = 4096, 3, 12
+    path = os.path.join(tmp, "ffm.txt")
+    with open(path, "w") as fh:
+        fh.write("\n".join(synth_ffm_lines((n_warm + n_timed) * B_ffm,
+                                           1 << 18)) + "\n")
+    cfg = FmConfig(vocabulary_size=1 << 18, factor_num=4, batch_size=B_ffm,
+                   model_type="ffm", field_num=24, learning_rate=0.05,
+                   factor_lambda=1e-6, bias_lambda=1e-6,
+                   max_features_per_example=32, bucket_ladder=(32,),
+                   train_files=(path,), shuffle=False)
+    step = make_train_step(ModelSpec.from_config(cfg))
+    return run_e2e(cfg, step, n_warm=n_warm)
 
 
 def run_h2d_only(cfg):
@@ -173,6 +209,7 @@ def main():
         # Per-worker input rate of the 2-way byte-range sharded fast path
         # (what each process's pipeline sustains in multi-process mode).
         shard = run_host_only(cfg, shard_index=0, num_shards=2)
+        ffm = run_ffm_e2e(tmp)
 
     eps = statistics.median(e2e)
     print(json.dumps({
@@ -185,6 +222,7 @@ def main():
         "device_only": round(dev, 1),
         "h2d_only": round(h2d, 1),
         "sharded_input_per_worker": round(shard, 1),
+        "ffm_e2e": round(ffm, 1),
     }))
 
 

@@ -4,7 +4,8 @@
 // TensorFlow custom op (upstream cc/fm_parser.cc; SURVEY.md §2). This is
 // the same job as a dependency-free shared object driven through ctypes
 // (fast_tffm_tpu/data/cparser.py): a newline-separated blob of
-//     <label> <fid>[:<fval>] ...
+//     <label> <fid>[:<fval>] ...            (FM)
+//     <label> <field>:<fid>[:<fval>] ...    (FFM, field_aware mode)
 // lines in, CSR arrays out. Semantics must match the Python parser
 // (fast_tffm_tpu/data/parser.py) bit-for-bit — including MurmurHash64A
 // feature hashing — and golden tests (tests/test_cparser.py) enforce it.
@@ -67,6 +68,7 @@ struct ShardOut {
   std::vector<int32_t> sizes;  // per-example nnz
   std::vector<int32_t> ids;
   std::vector<float> vals;
+  std::vector<int32_t> fields;  // field-aware (FFM) mode only
   bool failed = false;
   std::string error;
 };
@@ -174,11 +176,104 @@ void fail(ShardOut* out, int64_t lineno, const std::string& msg) {
   out->error = "line " + std::to_string(lineno) + ": " + msg;
 }
 
+// One feature token parsed. FM: `fid[:val]`; field-aware (FFM):
+// `field:fid[:val]`. Mirrors parser.py's tok.split(":") handling
+// exactly, including error wording (golden tests pin output parity).
+struct Token {
+  int32_t row;
+  int32_t field;  // field-aware only
+  float val;
+};
+
+// Scan one whitespace-delimited token, recording its first two colons
+// and whether more exist — one pass shared with token-boundary
+// detection (the parse loops are the host throughput ceiling; the
+// bytes must not be walked twice).
+inline const char* scan_token(const char* q, const char* line_end,
+                              const char** c1, const char** c2,
+                              bool* extra) {
+  *c1 = *c2 = nullptr;
+  *extra = false;
+  const char* s = q;
+  while (s < line_end && !is_ws(*s)) {
+    if (*s == ':') {
+      if (*c1 == nullptr) *c1 = s;
+      else if (*c2 == nullptr) *c2 = s;
+      else *extra = true;
+    }
+    s++;
+  }
+  return s;  // tok_end
+}
+
+// Returns 0 ok, 1 parse error (message in *err). c1/c2/extra come from
+// scan_token over [q, tok_end).
+inline int parse_token(const char* q, const char* tok_end,
+                       const char* c1, const char* c2, bool extra,
+                       int64_t vocab, bool hash_ids, bool field_aware,
+                       int64_t field_num, Token* t, std::string* err) {
+  const char* fid_begin = q;
+  const char* fid_end;
+  const char* val_begin = nullptr;  // null = default 1.0
+  if (field_aware) {
+    if (c1 == nullptr || extra) {
+      *err = "bad ffm token '" + std::string(q, tok_end) +
+             "' (want field:fid[:val])";
+      return 1;
+    }
+    int64_t fld;
+    if (!parse_int(q, c1, &fld)) {
+      *err = "bad field '" + std::string(q, c1) + "'";
+      return 1;
+    }
+    if (fld < 0 || fld >= field_num) {
+      *err = "field " + std::to_string(fld) + " out of range [0, " +
+             std::to_string(field_num) + ")";
+      return 1;
+    }
+    t->field = int32_t(fld);
+    fid_begin = c1 + 1;
+    fid_end = c2 ? c2 : tok_end;
+    if (c2) val_begin = c2 + 1;
+  } else {
+    if (c2 != nullptr || extra) {
+      *err = "bad token '" + std::string(q, tok_end) + "' (want fid[:val])";
+      return 1;
+    }
+    t->field = 0;
+    fid_end = c1 ? c1 : tok_end;
+    if (c1) val_begin = c1 + 1;
+  }
+  if (hash_ids) {
+    t->row = int32_t(murmur64(fid_begin, size_t(fid_end - fid_begin), 0) %
+                     uint64_t(vocab));
+  } else {
+    int64_t fid;
+    if (!parse_int(fid_begin, fid_end, &fid)) {
+      *err = "non-integer feature id '" + std::string(fid_begin, fid_end) +
+             "' (set hash_feature_id = True for string ids)";
+      return 1;
+    }
+    if (fid < 0 || fid >= vocab) {
+      *err = "feature id " + std::to_string(fid) + " out of range [0, " +
+             std::to_string(vocab) + ")";
+      return 1;
+    }
+    t->row = int32_t(fid);
+  }
+  t->val = 1.0f;
+  if (val_begin != nullptr && !parse_float(val_begin, tok_end, &t->val)) {
+    *err = "bad value '" + std::string(val_begin, tok_end) + "'";
+    return 1;
+  }
+  return 0;
+}
+
 // Parse lines [begin, end) of the blob (byte offsets of line starts are
 // implicit: we scan). `first_lineno` is for error messages only.
 void parse_range(const char* blob, const char* end, int64_t first_lineno,
-                 int64_t vocab, bool hash_ids, int max_feats,
-                 ShardOut* out) {
+                 int64_t vocab, bool hash_ids, bool field_aware,
+                 int64_t field_num, int max_feats, ShardOut* out) {
   const char* p = blob;
   int64_t lineno = first_lineno;
   while (p < end) {
@@ -208,56 +303,25 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
     while (true) {
       while (q < line_end && is_ws(*q)) q++;
       if (q >= line_end) break;
-      tok_end = q;
-      const char* colon = nullptr;
-      bool extra_colon = false;
-      while (tok_end < line_end && !is_ws(*tok_end)) {
-        if (*tok_end == ':') {
-          if (colon != nullptr) extra_colon = true;
-          else colon = tok_end;
-        }
-        tok_end++;
-      }
+      const char* c1;
+      const char* c2;
+      bool extra;
+      tok_end = scan_token(q, line_end, &c1, &c2, &extra);
       if (max_feats > 0 && n_feats >= max_feats) {
         // Python breaks out at the cap without validating the tail of
         // the line; skipping (not erroring) matches that.
         q = tok_end;
         continue;
       }
-      if (extra_colon) {
-        return fail(out, lineno,
-                    "bad token '" + std::string(q, tok_end) +
-                        "' (want fid[:val])");
+      Token t;
+      std::string err;
+      if (parse_token(q, tok_end, c1, c2, extra, vocab, hash_ids,
+                      field_aware, field_num, &t, &err)) {
+        return fail(out, lineno, err);
       }
-      const char* fid_end = colon ? colon : tok_end;
-      int32_t row;
-      if (hash_ids) {
-        row = int32_t(murmur64(q, size_t(fid_end - q), 0) %
-                      uint64_t(vocab));
-      } else {
-        int64_t fid;
-        if (!parse_int(q, fid_end, &fid)) {
-          return fail(out, lineno,
-                      "non-integer feature id '" +
-                          std::string(q, fid_end) +
-                          "' (set hash_feature_id = True for string ids)");
-        }
-        if (fid < 0 || fid >= vocab) {
-          return fail(out, lineno,
-                      "feature id " + std::to_string(fid) +
-                          " out of range [0, " + std::to_string(vocab) +
-                          ")");
-        }
-        row = int32_t(fid);
-      }
-      float val = 1.0f;
-      if (colon != nullptr &&
-          !parse_float(colon + 1, tok_end, &val)) {
-        return fail(out, lineno,
-                    "bad value '" + std::string(colon + 1, tok_end) + "'");
-      }
-      out->ids.push_back(row);
-      out->vals.push_back(val);
+      out->ids.push_back(t.row);
+      out->vals.push_back(t.val);
+      if (field_aware) out->fields.push_back(t.field);
       n_feats++;
       q = tok_end;
     }
@@ -271,15 +335,26 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
 
 extern "C" {
 
+// Bumped whenever any exported signature changes. cparser.py refuses a
+// .so reporting a different version: the mtime/symbol checks alone
+// cannot catch a stale binary whose symbols still exist but whose
+// argument layouts moved (silent data corruption, not a load error).
+// History: 1 = initial; 2 = field-aware (FFM) params + fields buffers.
+int64_t fm_abi_version() { return 2; }
+
 // Returns 0 on success. Outputs:
 //   labels[n_examples], poses[n_examples+1], ids[nnz], vals[nnz]
-// Caller allocates: labels/poses sized for the line count, ids/vals for
-// the worst-case token count (cparser.py sizes them from the blob).
+//   (+ fields[nnz] when field_aware — FFM `field:fid[:val]` tokens)
+// Caller allocates: labels/poses sized for the line count, ids/vals/
+// fields for the worst-case token count (cparser.py sizes them from the
+// blob). fields_out may be null when !field_aware.
 int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
-                   int hash_ids, int max_feats, int num_threads,
+                   int hash_ids, int field_aware, int64_t field_num,
+                   int max_feats, int num_threads,
                    int64_t* n_examples_out, int64_t* nnz_out,
                    float* labels_out, int32_t* poses_out, int32_t* ids_out,
-                   float* vals_out, char* err_out, int64_t err_cap) {
+                   float* vals_out, int32_t* fields_out, char* err_out,
+                   int64_t err_cap) {
   if (vocab <= 0) {
     std::snprintf(err_out, size_t(err_cap), "vocabulary_size must be > 0");
     return 1;
@@ -321,7 +396,8 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
   for (int s = 0; s < shards; s++) {
     threads.emplace_back(parse_range, starts[size_t(s)],
                          starts[size_t(s) + 1], first_lineno[size_t(s)],
-                         vocab, hash_ids != 0, max_feats, &outs[size_t(s)]);
+                         vocab, hash_ids != 0, field_aware != 0, field_num,
+                         max_feats, &outs[size_t(s)]);
   }
   for (auto& th : threads) th.join();
 
@@ -340,6 +416,10 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
                 o.labels.size() * sizeof(float));
     std::memcpy(ids_out + z, o.ids.data(), o.ids.size() * sizeof(int32_t));
     std::memcpy(vals_out + z, o.vals.data(), o.vals.size() * sizeof(float));
+    if (field_aware != 0 && fields_out != nullptr) {
+      std::memcpy(fields_out + z, o.fields.data(),
+                  o.fields.size() * sizeof(int32_t));
+    }
     for (size_t e = 0; e < o.sizes.size(); e++) {
       poses_out[b + int64_t(e) + 1] =
           poses_out[b + int64_t(e)] + o.sizes[e];
@@ -372,12 +452,15 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
 struct BatchBuilder {
   int64_t B, L, vocab;
   bool hash_ids;
+  bool field_aware = false;  // FFM `field:fid[:val]` tokens
+  int64_t field_num = 0;
   int max_feats;
   int64_t max_uniq;  // 0 = unlimited; else batch closes BEFORE exceeding
   std::vector<float> labels;    // [B]
   std::vector<int32_t> uniq;    // [B*L + 1]
   std::vector<int32_t> li;      // [B*L], default 0 (pad slot)
   std::vector<float> vals;      // [B*L], default 0
+  std::vector<int32_t> fields;  // [B*L] (field_aware only), default 0
   std::vector<int32_t> slot;    // dedup table -> slot index
   std::vector<uint32_t> stamp;  // dedup table stamping
   std::vector<uint32_t> line_slots;  // hash slots inserted by current line
@@ -399,6 +482,10 @@ void bb_reset(BatchBuilder* bb) {
   bb->cur_stamp++;
   std::memset(bb->li.data(), 0, size_t(bb->B * bb->L) * sizeof(int32_t));
   std::memset(bb->vals.data(), 0, size_t(bb->B * bb->L) * sizeof(float));
+  if (bb->field_aware) {
+    std::memset(bb->fields.data(), 0,
+                size_t(bb->B * bb->L) * sizeof(int32_t));
+  }
 }
 
 inline int32_t bb_slot(BatchBuilder* bb, int32_t key) {
@@ -431,13 +518,17 @@ inline void bb_rollback_line(BatchBuilder* bb, int32_t saved_uniq) {
 extern "C" {
 
 void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
-                int max_feats, int64_t max_uniq) {
+                int field_aware, int64_t field_num, int max_feats,
+                int64_t max_uniq) {
   if (B <= 0 || L <= 0 || vocab <= 0) return nullptr;
+  if (field_aware != 0 && field_num <= 0) return nullptr;
   auto* bb = new BatchBuilder();
   bb->B = B;
   bb->L = L;
   bb->vocab = vocab;
   bb->hash_ids = hash_ids != 0;
+  bb->field_aware = field_aware != 0;
+  bb->field_num = field_num;
   bb->max_feats = (max_feats > 0 && max_feats < L) ? max_feats : int(L);
   // A single line adds <= max_feats uniques (+ the pad slot), so the cap
   // must exceed that or one line could never fit in an empty batch.
@@ -451,6 +542,7 @@ void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
   bb->uniq[0] = int32_t(vocab);  // pad slot
   bb->li.assign(size_t(B * L), 0);
   bb->vals.assign(size_t(B * L), 0.0f);
+  if (bb->field_aware) bb->fields.assign(size_t(B * L), 0);
   size_t cap = 16;
   while (cap < size_t(B * L) * 2) cap <<= 1;
   bb->mask = uint32_t(cap - 1);
@@ -493,6 +585,9 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
     }
     float* vrow = bb->vals.data() + bb->n_ex * bb->L;
     int32_t* irow = bb->li.data() + bb->n_ex * bb->L;
+    int32_t* frow = bb->field_aware
+                        ? bb->fields.data() + bb->n_ex * bb->L
+                        : nullptr;
     int n_feats = 0;
     bb->line_slots.clear();
     const int32_t saved_uniq = bb->n_uniq;
@@ -500,58 +595,25 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
     while (true) {
       while (q < line_end && is_ws(*q)) q++;
       if (q >= line_end) break;
-      tok_end = q;
-      const char* colon = nullptr;
-      bool extra_colon = false;
-      while (tok_end < line_end && !is_ws(*tok_end)) {
-        if (*tok_end == ':') {
-          if (colon != nullptr) extra_colon = true;
-          else colon = tok_end;
-        }
-        tok_end++;
-      }
+      const char* c1;
+      const char* c2;
+      bool extra;
+      tok_end = scan_token(q, line_end, &c1, &c2, &extra);
       if (n_feats >= bb->max_feats) {  // cap: skip tail like Python
         q = tok_end;
         continue;
       }
-      if (extra_colon) {
-        std::snprintf(err_out, size_t(err_cap),
-                      "line %lld: bad token '%.*s' (want fid[:val])",
-                      (long long)bb->lineno, int(tok_end - q), q);
+      Token t;
+      std::string terr;
+      if (parse_token(q, tok_end, c1, c2, extra, bb->vocab, bb->hash_ids,
+                      bb->field_aware, bb->field_num, &t, &terr)) {
+        std::snprintf(err_out, size_t(err_cap), "line %lld: %s",
+                      (long long)bb->lineno, terr.c_str());
         return -1;
       }
-      const char* fid_end = colon ? colon : tok_end;
-      int32_t row;
-      if (bb->hash_ids) {
-        row = int32_t(murmur64(q, size_t(fid_end - q), 0) %
-                      uint64_t(bb->vocab));
-      } else {
-        int64_t fid;
-        if (!parse_int(q, fid_end, &fid)) {
-          std::snprintf(err_out, size_t(err_cap),
-                        "line %lld: non-integer feature id '%.*s' (set "
-                        "hash_feature_id = True for string ids)",
-                        (long long)bb->lineno, int(fid_end - q), q);
-          return -1;
-        }
-        if (fid < 0 || fid >= bb->vocab) {
-          std::snprintf(err_out, size_t(err_cap),
-                        "line %lld: feature id %lld out of range [0, %lld)",
-                        (long long)bb->lineno, (long long)fid,
-                        (long long)bb->vocab);
-          return -1;
-        }
-        row = int32_t(fid);
-      }
-      float val = 1.0f;
-      if (colon != nullptr && !parse_float(colon + 1, tok_end, &val)) {
-        std::snprintf(err_out, size_t(err_cap), "line %lld: bad value '%.*s'",
-                      (long long)bb->lineno, int(tok_end - colon - 1),
-                      colon + 1);
-        return -1;
-      }
-      irow[n_feats] = bb_slot(bb, row);
-      vrow[n_feats] = val;
+      irow[n_feats] = bb_slot(bb, t.row);
+      vrow[n_feats] = t.val;
+      if (frow != nullptr) frow[n_feats] = t.field;
       n_feats++;
       q = tok_end;
     }
@@ -563,6 +625,9 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
       bb_rollback_line(bb, saved_uniq);
       std::memset(irow, 0, size_t(n_feats) * sizeof(int32_t));
       std::memset(vrow, 0, size_t(n_feats) * sizeof(float));
+      if (frow != nullptr) {
+        std::memset(frow, 0, size_t(n_feats) * sizeof(int32_t));
+      }
       bb->lineno--;  // will be re-fed
       if (bb->n_ex == 0) {
         std::snprintf(err_out, size_t(err_cap),
@@ -585,10 +650,11 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
 
 // Copy the accumulated batch out and reset for the next one.
 // labels_out[B], uniq_out[n_uniq] (slot 0 = pad_id), li_out[B*L],
-// vals_out[B*L]. Returns n_examples (0 if the batch is empty).
+// vals_out[B*L], fields_out[B*L] (field_aware builders only; may be
+// null otherwise). Returns n_examples (0 if the batch is empty).
 int64_t fm_bb_finish(void* h, float* labels_out, int32_t* uniq_out,
-                     int32_t* li_out, float* vals_out, int64_t* n_uniq_out,
-                     int64_t* max_nnz_out) {
+                     int32_t* li_out, float* vals_out, int32_t* fields_out,
+                     int64_t* n_uniq_out, int64_t* max_nnz_out) {
   auto* bb = static_cast<BatchBuilder*>(h);
   const int64_t n = bb->n_ex;
   std::memcpy(labels_out, bb->labels.data(), size_t(n) * sizeof(float));
@@ -597,6 +663,10 @@ int64_t fm_bb_finish(void* h, float* labels_out, int32_t* uniq_out,
   std::memcpy(li_out, bb->li.data(), size_t(bb->B * bb->L) * sizeof(int32_t));
   std::memcpy(vals_out, bb->vals.data(),
               size_t(bb->B * bb->L) * sizeof(float));
+  if (bb->field_aware && fields_out != nullptr) {
+    std::memcpy(fields_out, bb->fields.data(),
+                size_t(bb->B * bb->L) * sizeof(int32_t));
+  }
   *n_uniq_out = bb->n_uniq;
   *max_nnz_out = bb->max_nnz;
   bb_reset(bb);

@@ -38,6 +38,33 @@ def _build() -> None:
     subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
+# Must equal fm_abi_version() in _parser.cc. Bump both together whenever
+# an exported signature changes.
+_ABI_VERSION = 2
+
+
+def _open_checked() -> Optional[ctypes.CDLL]:
+    """dlopen the .so and verify every symbol exists AND the compiled-in
+    ABI version matches this wrapper. Returns None on version mismatch
+    (caller decides whether a rebuild is possible); raises AttributeError
+    on missing symbols like before."""
+    lib = ctypes.CDLL(_SO)
+    # Touch every symbol: a stale .so missing a newer entry point must
+    # route to the fallback path too.
+    lib.fm_abi_version
+    lib.fm_parse_block
+    lib.fm_dedup_ids
+    lib.fm_bb_new
+    lib.fm_bb_feed
+    lib.fm_bb_finish
+    lib.fm_bb_free
+    lib.fm_abi_version.restype = ctypes.c_int64
+    lib.fm_abi_version.argtypes = []
+    if lib.fm_abi_version() != _ABI_VERSION:
+        return None
+    return lib
+
+
 def _load() -> ctypes.CDLL:
     global _lib, _load_error
     with _lock:
@@ -52,23 +79,31 @@ def _load() -> ctypes.CDLL:
                 if not os.path.exists(_SRC):
                     raise FileNotFoundError(_SRC)
                 _build()
-            lib = ctypes.CDLL(_SO)
-            # Touch every symbol inside the try: a stale .so missing a
-            # newer entry point must route to the fallback path too.
-            lib.fm_parse_block
-            lib.fm_dedup_ids
-            lib.fm_bb_new
-            lib.fm_bb_feed
-            lib.fm_bb_finish
-            lib.fm_bb_free
+            lib = _open_checked()
+            if lib is None:
+                # ABI drift with source present: rebuild once and retry
+                # (an mtime-preserving deploy can leave a stale .so
+                # "newer" than the source; symbols alone can't catch
+                # changed argument layouts — silent corruption).
+                if not os.path.exists(_SRC):
+                    raise RuntimeError(
+                        f"{_SO} reports a different ABI version and no "
+                        "source is present to rebuild")
+                _build()
+                lib = _open_checked()
+                if lib is None:
+                    raise RuntimeError(
+                        f"{_SO} still reports a different ABI version "
+                        "after rebuild")
         except (OSError, FileNotFoundError, AttributeError,
-                subprocess.CalledProcessError) as e:
+                subprocess.CalledProcessError, RuntimeError) as e:
             _load_error = f"C++ parser unavailable: {e}"
             raise RuntimeError(_load_error)
         lib.fm_parse_block.restype = ctypes.c_int
         lib.fm_parse_block.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,              # buffer, length
             ctypes.c_int64, ctypes.c_int,                 # vocab, hash flag
+            ctypes.c_int, ctypes.c_int64,                 # field flag, count
             ctypes.c_int,                                 # max feats/example
             ctypes.c_int,                                 # num threads
             ctypes.POINTER(ctypes.c_int64),               # out: n_examples
@@ -77,6 +112,7 @@ def _load() -> ctypes.CDLL:
             np.ctypeslib.ndpointer(np.int32),             # poses buf
             np.ctypeslib.ndpointer(np.int32),             # ids buf
             np.ctypeslib.ndpointer(np.float32),           # vals buf
+            np.ctypeslib.ndpointer(np.int32),             # fields buf
             ctypes.c_char_p, ctypes.c_int64,              # err buf, err cap
         ]
         lib.fm_dedup_ids.restype = ctypes.c_int64
@@ -87,8 +123,9 @@ def _load() -> ctypes.CDLL:
         ]
         lib.fm_bb_new.restype = ctypes.c_void_p
         lib.fm_bb_new.argtypes = [ctypes.c_int64, ctypes.c_int64,
-                                  ctypes.c_int64, ctypes.c_int, ctypes.c_int,
-                                  ctypes.c_int64]
+                                  ctypes.c_int64, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int64,  # field flag, count
+                                  ctypes.c_int, ctypes.c_int64]
         lib.fm_bb_free.argtypes = [ctypes.c_void_p]
         lib.fm_bb_feed.restype = ctypes.c_int
         lib.fm_bb_feed.argtypes = [
@@ -101,6 +138,7 @@ def _load() -> ctypes.CDLL:
             np.ctypeslib.ndpointer(np.int32),             # uniq
             np.ctypeslib.ndpointer(np.int32),             # local_idx
             np.ctypeslib.ndpointer(np.float32),           # vals
+            np.ctypeslib.ndpointer(np.int32),             # fields
             ctypes.POINTER(ctypes.c_int64),               # n_uniq
             ctypes.POINTER(ctypes.c_int64)]               # max_nnz
         _lib = lib
@@ -117,11 +155,12 @@ def available() -> bool:
 
 def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
                      hash_feature_id: bool = False,
+                     field_aware: bool = False, field_num: int = 0,
                      max_features_per_example: int = 0,
                      num_threads: int = 0) -> ParsedBlock:
-    """C++-accelerated ``parse_lines`` (FM format only; FFM uses the
-    Python parser). Raises RuntimeError when the extension is unusable,
-    ParseError on malformed input."""
+    """C++-accelerated ``parse_lines`` (FM and field-aware FFM formats).
+    Raises RuntimeError when the extension is unusable, ParseError on
+    malformed input."""
     lib = _load()
     blob = "\n".join(lines).encode("utf-8")
     n_lines = len(lines)
@@ -132,20 +171,23 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
     poses = np.empty(n_lines + 1, dtype=np.int32)
     ids = np.empty(max_nnz, dtype=np.int32)
     vals = np.empty(max_nnz, dtype=np.float32)
+    fields = np.empty(max_nnz if field_aware else 1, dtype=np.int32)
     n_ex = ctypes.c_int64(0)
     nnz = ctypes.c_int64(0)
     errbuf = ctypes.create_string_buffer(512)
     rc = lib.fm_parse_block(
         blob, len(blob), vocabulary_size, int(hash_feature_id),
+        int(field_aware), field_num,
         max_features_per_example, num_threads,
         ctypes.byref(n_ex), ctypes.byref(nnz),
-        labels, poses, ids, vals, errbuf, len(errbuf))
+        labels, poses, ids, vals, fields, errbuf, len(errbuf))
     if rc != 0:
         raise ParseError(errbuf.value.decode("utf-8", "replace"))
     b = n_ex.value
     z = nnz.value
     return ParsedBlock(labels=labels[:b].copy(), poses=poses[:b + 1].copy(),
-                       ids=ids[:z].copy(), vals=vals[:z].copy(), fields=None)
+                       ids=ids[:z].copy(), vals=vals[:z].copy(),
+                       fields=fields[:z].copy() if field_aware else None)
 
 
 class BatchBuilder:
@@ -161,16 +203,21 @@ class BatchBuilder:
 
     def __init__(self, batch_size: int, max_cols: int,
                  vocabulary_size: int, hash_feature_id: bool = False,
+                 field_aware: bool = False, field_num: int = 0,
                  max_features_per_example: int = 0, max_uniq: int = 0):
         """``max_uniq`` > 0 caps the batch's unique-row count (incl. the
         pad slot): a line that would exceed it closes the batch early
         (spill) and opens the next one — the fixed-U protocol for
-        multi-process SPMD. Must exceed the per-example feature cap."""
+        multi-process SPMD. Must exceed the per-example feature cap.
+        ``field_aware`` parses FFM ``field:fid[:val]`` tokens and makes
+        ``finish()`` return a fields array."""
         self._lib = _load()
         self.B, self.L = batch_size, max_cols
+        self.field_aware = field_aware
         self._h = self._lib.fm_bb_new(batch_size, max_cols,
                                       vocabulary_size,
                                       int(hash_feature_id),
+                                      int(field_aware), field_num,
                                       max_features_per_example,
                                       max_uniq)
         if not self._h:
@@ -199,18 +246,20 @@ class BatchBuilder:
 
     def finish(self):
         """-> (n_examples, labels[B], uniq[n_uniq], local_idx[B,L],
-        vals[B,L], max_nnz); resets the builder."""
+        vals[B,L], fields[B,L]-or-None, max_nnz); resets the builder."""
         labels = np.empty(self.B, np.float32)
         uniq = np.empty(self.B * self.L + 1, np.int32)
         li = np.empty((self.B, self.L), np.int32)
         vals = np.empty((self.B, self.L), np.float32)
+        fields = np.empty((self.B, self.L) if self.field_aware else (1, 1),
+                          np.int32)
         n_uniq = ctypes.c_int64(0)
         max_nnz = ctypes.c_int64(0)
-        n = self._lib.fm_bb_finish(self._h, labels, uniq, li, vals,
+        n = self._lib.fm_bb_finish(self._h, labels, uniq, li, vals, fields,
                                    ctypes.byref(n_uniq),
                                    ctypes.byref(max_nnz))
         return (int(n), labels, uniq[:n_uniq.value].copy(), li, vals,
-                int(max_nnz.value))
+                fields if self.field_aware else None, int(max_nnz.value))
 
     def __del__(self):
         h = getattr(self, "_h", None)

@@ -355,8 +355,8 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
     permutation — the same mixing radius as the reference's bounded
     shuffle queue of ``queue_size`` lines (SURVEY §2 "Input pipeline"),
     expressed at batch granularity. Exact reservoir-per-line semantics
-    remain on the generic path (weight files / FFM / the Python parser
-    force it).
+    remain on the generic path (weight files / keep_empty / the Python
+    parser force it; FFM rides this fast path via field-aware tokens).
 
     With ``uniq_bucket`` (fixed_shape multi-process mode) the builder
     caps each batch's unique rows; a too-dense batch closes early with
@@ -368,7 +368,7 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
     window: List[DeviceBatch] = []
     window_cap = max(2, cfg.queue_size // B) if shuffle else 1
 
-    def emit(n, labels, uniq, li, vals, max_nnz,
+    def emit(n, labels, uniq, li, vals, fields, max_nnz,
              spilled: bool = False) -> DeviceBatch:
         if stats is not None:
             stats.count(n, B, spilled)
@@ -377,6 +377,8 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
         if L < L_cap:
             li = np.ascontiguousarray(li[:, :L])
             vals = np.ascontiguousarray(vals[:, :L])
+            if fields is not None:
+                fields = np.ascontiguousarray(fields[:, :L])
         if fixed_shape and uniq_bucket:
             U = uniq_bucket  # builder guarantees len(uniq) <= U
         else:
@@ -395,9 +397,11 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                                    np.arange(n, B)])
             labels, weights = labels[perm], weights[perm]
             li, vals = li[perm], vals[perm]
+            if fields is not None:
+                fields = fields[perm]
         return DeviceBatch(labels=labels, weights=weights,
                            uniq_ids=uniq_ids, local_idx=li, vals=vals,
-                           fields=None, num_real=n)
+                           fields=fields, num_real=n)
 
     def drain(batch: DeviceBatch) -> Iterator[DeviceBatch]:
         if shuffle:
@@ -432,9 +436,10 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                 yield from feed_all(tail + chunk if tail else chunk)
             if tail:  # final owned line missing its newline
                 yield from feed_all(tail + b"\n")
-        n, labels, uniq, li, vals, max_nnz = bb.finish()
+        n, labels, uniq, li, vals, fields, max_nnz = bb.finish()
         if n:  # short final batch of the epoch
-            yield from drain(emit(n, labels, uniq, li, vals, max_nnz))
+            yield from drain(emit(n, labels, uniq, li, vals, fields,
+                                  max_nnz))
         while window:
             yield window.pop(pyrng.randrange(len(window)))
 
@@ -474,11 +479,11 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
 
     # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
     # no feature needs per-line Python handling — including sharded
-    # multi-process input (byte ranges). Requires a hard per-example cap
-    # (the builder writes fixed-stride rows); max_features_per_example =
-    # 0 means "unlimited" and stays generic.
+    # multi-process input (byte ranges) and field-aware FFM tokens.
+    # Requires a hard per-example cap (the builder writes fixed-stride
+    # rows); max_features_per_example = 0 means "unlimited" and stays
+    # generic.
     if (not keep_empty and not weight_files
-            and cfg.model_type != "ffm"
             and cfg.max_features_per_example > 0):
         try:
             from fast_tffm_tpu.data.cparser import BatchBuilder
@@ -488,6 +493,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             L_cap = effective_L_cap(cfg)
             bb = BatchBuilder(B, L_cap, cfg.vocabulary_size,
                               hash_feature_id=cfg.hash_feature_id,
+                              field_aware=cfg.model_type == "ffm",
+                              field_num=cfg.field_num,
                               max_features_per_example=(
                                   cfg.max_features_per_example),
                               max_uniq=(uniq_bucket if fixed_shape else 0))
@@ -501,8 +508,7 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             return
     # keep_empty needs blank lines to become zero-feature examples; only
     # the Python parser implements that.
-    parse = (None if cfg.model_type == "ffm" or keep_empty
-             else parse_lines_fast)
+    parse = None if keep_empty else parse_lines_fast
 
     for _ in range(n_epochs):
         pending: List[Tuple[str, float]] = []
@@ -592,7 +598,7 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
     files = expand_files(files)
     top = _uniq_ladder(B, effective_L_cap(cfg))[-1]
     from fast_tffm_tpu.data.cparser import parse_lines_fast
-    parse = None if cfg.model_type == "ffm" else parse_lines_fast
+    parse = parse_lines_fast
 
     # One batch from the head, middle, and tail of the first file (byte
     # offsets, first-newline aligned like shard_byte_range): sorted or
@@ -724,6 +730,7 @@ def _parse_block(lines: Sequence[str], cfg: FmConfig, fast_parse,
             return fast_parse(
                 lines, cfg.vocabulary_size,
                 hash_feature_id=cfg.hash_feature_id,
+                field_aware=field_aware, field_num=cfg.field_num,
                 max_features_per_example=cfg.max_features_per_example)
         except (OSError, RuntimeError):
             pass  # C++ extension unavailable -> Python fallback

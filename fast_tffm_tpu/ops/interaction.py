@@ -81,9 +81,18 @@ def ffm_batch_scores(params: jax.Array, field_num: int,
 
         score = Σ_j w_j x_j + Σ_{i<j} <v[i, f_j], v[j, f_i]> x_i x_j
 
-    Uses a one-hot field projection → [B, L, L, k] pair tensor; fine for
-    FFM's typical L of a few dozen fields (the per-example pair count is
-    quadratic by definition of FFM).
+    Computed by bucketing features by field instead of forming the
+    [B, L, L, k] pair tensor (which is ~2.7 GB at L=256/B=1024):
+
+        S[b, f, g, :] = Σ_{l : fields[b,l]=g} x_l · v[b, l, f, :]
+        Σ_{i,j} <v_i[f_j], v_j[f_i]> x_i x_j = Σ_{f,g} <S[f,g], S[g,f]>
+
+    (each ordered pair (i, j) lands in the (f, g) = (f_j, f_i) bucket
+    exactly once), then the i=j diagonal Σ_l x_l²·||v_l[f_l]||² is
+    subtracted and the sum halved. The biggest intermediate is
+    [B, F, F, k] — bounded by the field count, not the feature bucket —
+    and the L-contraction is a plain matmul the MXU tiles. Padded slots
+    have x=0 and contribute zero everywhere.
     """
     rows = params[local_idx]                       # [B, L, F*k+1]
     B, L = local_idx.shape
@@ -92,12 +101,15 @@ def ffm_batch_scores(params: jax.Array, field_num: int,
     v = rows[..., :-1].reshape(B, L, field_num, k)
     linear = jnp.einsum("bl,bl->b", w, vals, precision=_F32)
     onehot = jax.nn.one_hot(fields, field_num, dtype=v.dtype)  # [B, L, F]
-    # t[b,i,j,:] = v[b, i, fields[b, j], :]
-    t = jnp.einsum("bifk,bjf->bijk", v, onehot, precision=_F32)
-    m = jnp.einsum("bijk,bjik->bij", t, t, precision=_F32)  # <v[i,f_j], v[j,f_i]>
-    xx = vals[:, :, None] * vals[:, None, :]       # [B, L, L]
-    diag = jnp.einsum("bii->b", m * xx)
-    return linear + 0.5 * ((m * xx).sum(axis=(1, 2)) - diag)
+    # S[b,f,g,:] = Σ_l onehot[b,l,g] · x[b,l] · v[b,l,f,:]
+    s = jnp.einsum("blfk,blg,bl->bfgk", v, onehot, vals, precision=_F32)
+    cross = jnp.einsum("bfgk,bgfk->b", s, s, precision=_F32)
+    # i=j diagonal: v each feature uses against its own field.
+    v_self = jnp.take_along_axis(
+        v, fields[:, :, None, None], axis=2)[:, :, 0, :]       # [B, L, k]
+    diag = jnp.einsum("blk,blk,bl->b", v_self, v_self,
+                      jnp.square(vals), precision=_F32)
+    return linear + 0.5 * (cross - diag)
 
 
 def batch_reg(params: jax.Array, uniq_ids: jax.Array, vocabulary_size: int,

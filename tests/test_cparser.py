@@ -18,6 +18,10 @@ def assert_parity(lines, vocab, **kw):
     np.testing.assert_array_equal(cc.poses, py.poses)
     np.testing.assert_array_equal(cc.ids, py.ids)
     np.testing.assert_array_equal(cc.vals, py.vals)
+    if py.fields is None:
+        assert cc.fields is None
+    else:
+        np.testing.assert_array_equal(cc.fields, py.fields)
 
 
 def test_basic_parity():
@@ -91,6 +95,56 @@ def test_empty_input():
     cc = cparser.parse_lines_fast([], 10)
     assert cc.batch_size == 0
     assert len(cc.ids) == 0
+
+
+def test_ffm_parity():
+    lines = ["1 0:3:0.5 1:7:2.0 2:1", "0 1:2", "1 0:9:1.5"]
+    assert_parity(lines, 100, field_aware=True, field_num=3)
+
+
+def test_ffm_hash_parity():
+    lines = ["1 0:user_a:2.0 1:item_b 2:click:0.5", "0 2:123:7.5"]
+    assert_parity(lines, 999983, hash_feature_id=True,
+                  field_aware=True, field_num=3)
+
+
+def test_ffm_truncation_parity():
+    line = "1 " + " ".join(f"{i % 4}:{i}:1" for i in range(50))
+    assert_parity([line], 100, field_aware=True, field_num=4,
+                  max_features_per_example=8)
+
+
+def test_ffm_error_parity():
+    kw = dict(field_aware=True, field_num=3)
+    for bad in (["1 5"],          # no field separator
+                ["1 x:2:1"],      # bad field
+                ["1 9:2:1"],      # field out of range
+                ["1 0:2:1:4"],    # too many colons
+                ["1 0:abc:1"],    # non-int id without hashing
+                ["1 0:50:1"]):    # id out of range (vocab 10)
+        with pytest.raises(ParseError):
+            parse_lines(bad, 10, **kw)
+        with pytest.raises(ParseError):
+            cparser.parse_lines_fast(bad, 10, **kw)
+
+
+def test_ffm_fuzz_parity(rng):
+    vocab, F = 10000, 7
+    lines = []
+    for _ in range(500):
+        n = int(rng.integers(1, 20))
+        toks = []
+        for _ in range(n):
+            fld = int(rng.integers(0, F))
+            fid = int(rng.integers(0, vocab))
+            if rng.uniform() < 0.5:
+                toks.append(f"{fld}:{fid}:{rng.normal():.6g}")
+            else:
+                toks.append(f"{fld}:{fid}")
+        lines.append(f"{int(rng.integers(0, 2))} " + " ".join(toks))
+    assert_parity(lines, vocab, field_aware=True, field_num=F)
+    assert_parity(lines, vocab, field_aware=True, field_num=F,
+                  hash_feature_id=True)
 
 
 def test_zero_padded_ids_parse_like_python():

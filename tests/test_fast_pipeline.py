@@ -43,8 +43,9 @@ def _example_stream(cfg, **kw):
             for j in range(b.local_idx.shape[1]):
                 fid = int(b.uniq_ids[b.local_idx[e, j]])
                 v = float(b.vals[e, j])
+                fld = int(b.fields[e, j]) if b.fields is not None else 0
                 if fid < cfg.vocabulary_size and v != 0.0:
-                    feats.append((fid, round(v, 6)))
+                    feats.append((fid, fld, round(v, 6)))
             out.append((float(b.labels[e]), tuple(sorted(feats))))
     return out
 
@@ -85,6 +86,55 @@ def test_fast_training_matches_generic_losses(tmp_path):
     spec = ModelSpec.from_config(cfg)
     wpath = tmp_path / "w.txt"
     wpath.write_text("1.0\n" * 128)
+    losses = {}
+    for name, kw in [("fast", {}),
+                     ("generic", {"weight_files": (str(wpath),)})]:
+        table, acc = init_table(cfg, 0), init_accumulator(cfg)
+        step = make_train_step(spec)
+        ls = []
+        for b in batch_iterator(cfg, cfg.train_files, training=True, **kw):
+            table, acc, loss, _ = step(table, acc, **batch_args(b))
+            ls.append(float(loss))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["fast"], losses["generic"],
+                               rtol=1e-6, atol=1e-7)
+
+
+def _write_ffm(tmp_path, n=120, seed=2, field_num=5, name="ffm.txt"):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        nnz = rng.integers(1, 10)
+        ids = rng.choice(300, size=nnz, replace=False)
+        toks = [f"{int(rng.integers(0, field_num))}:{i}:{rng.random():.4f}"
+                for i in ids]
+        lines.append(" ".join(["1" if rng.random() < 0.4 else "0"] + toks))
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_ffm_fast_matches_generic_stream(tmp_path):
+    """FFM rides the C++ BatchBuilder now (field-aware tokens); the
+    stream — including per-feature fields — must match the generic
+    Python-parser path exactly."""
+    path = _write_ffm(tmp_path)
+    cfg = _cfg(path, model_type="ffm", field_num=5)
+    fast = _example_stream(cfg)
+    wpath = tmp_path / "w.txt"
+    wpath.write_text("1.0\n" * 300)
+    generic = _example_stream(cfg, weight_files=(str(wpath),))
+    assert fast == generic
+    assert len(fast) == 120
+    assert any(f[1] != 0 for _, feats in fast for f in feats)
+
+
+def test_ffm_fast_training_matches_generic_losses(tmp_path):
+    path = _write_ffm(tmp_path, n=64, seed=8)
+    cfg = _cfg(path, model_type="ffm", field_num=5)
+    spec = ModelSpec.from_config(cfg)
+    wpath = tmp_path / "w.txt"
+    wpath.write_text("1.0\n" * 64)
     losses = {}
     for name, kw in [("fast", {}),
                      ("generic", {"weight_files": (str(wpath),)})]:

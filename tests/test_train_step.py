@@ -123,3 +123,59 @@ def test_loss_decreases_on_toy_problem():
             table, acc, loss, _ = step(table, acc, **batch_args(b))
             losses.append(float(loss))
     assert np.mean(losses[-16:]) < 0.55 * np.mean(losses[:16])
+
+
+def test_ffm_step_matches_fd_oracle():
+    """FFM backward (jax.grad through the field-bucketed interaction)
+    against dense finite differences of oracle.ffm_score + loss + reg,
+    pushed through one Adagrad step — the FFM analogue of
+    test_step_matches_oracle_adagrad."""
+    Vf, F, Kf = 16, 3, 2
+    cfg = FmConfig(vocabulary_size=Vf, factor_num=Kf, model_type="ffm",
+                   field_num=F, batch_size=4, bucket_ladder=(4, 8),
+                   learning_rate=0.1, factor_lambda=0.01, bias_lambda=0.02,
+                   adagrad_init=0.1)
+    spec = ModelSpec.from_config(cfg)
+    lines = ["1 0:3:0.5 1:7:1.0 2:9:2.0", "0 0:3:1.0 2:12:0.5",
+             "1 1:15:1.0", "0 2:7:0.25 0:15:1.0"]
+    batch = [([3, 7, 9], [0, 1, 2], [0.5, 1.0, 2.0]),
+             ([3, 12], [0, 2], [1.0, 0.5]),
+             ([15], [1], [1.0]),
+             ([7, 15], [2, 0], [0.25, 1.0])]
+    labels = np.array([1.0, 0.0, 1.0, 0.0])
+    block = parse_lines(lines, Vf, field_aware=True, field_num=F)
+    b = make_device_batch(block, cfg)
+
+    table0 = np.asarray(init_table(cfg, seed=4))
+    acc0 = np.asarray(init_accumulator(cfg))
+    step = make_train_step(spec)
+    t1, a1, loss, _ = step(jax.numpy.asarray(table0),
+                           jax.numpy.asarray(acc0), **batch_args(b))
+    t1 = np.asarray(t1)
+
+    t64 = table0[:-1].astype(np.float64)
+
+    def total(t):
+        s = np.array([oracle.ffm_score(t, F, ids, flds, vals)
+                      for ids, flds, vals in batch])
+        uniq = np.unique(np.concatenate([ids for ids, _, _ in batch]))
+        v, w = t[uniq, :-1], t[uniq, -1]
+        return (oracle.logistic_loss(s, labels)
+                + cfg.factor_lambda * np.sum(v * v)
+                + cfg.bias_lambda * np.sum(w * w))
+
+    eps = 1e-5
+    g = np.zeros_like(t64)
+    touched = np.unique(np.concatenate([ids for ids, _, _ in batch]))
+    for r in touched:
+        for c in range(t64.shape[1]):
+            t = t64.copy()
+            t[r, c] += eps
+            up = total(t)
+            t[r, c] -= 2 * eps
+            g[r, c] = (up - total(t)) / (2 * eps)
+
+    want_t, _ = oracle.adagrad_step(t64, acc0[:-1].astype(np.float64), g,
+                                    cfg.learning_rate)
+    np.testing.assert_allclose(t1[:-1], want_t, rtol=2e-3, atol=2e-4)
+    assert float(loss) == pytest.approx(total(t64), rel=1e-4)
