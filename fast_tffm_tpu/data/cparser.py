@@ -33,9 +33,20 @@ _load_error: Optional[str] = None
 
 
 def _build() -> None:
+    # Build to a temp name and os.replace: atomic for concurrent
+    # processes, and the fresh inode means a retry dlopen after an
+    # ABI-mismatch rebuild maps the NEW library (dlopen dedups by
+    # dev/inode — rebuilding in place would both hand back the stale
+    # mapping and rewrite a live mmap).
+    tmp = f"{_SO}.tmp.{os.getpid()}"
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-           "-pthread", "-o", _SO, _SRC]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
+           "-pthread", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 # Must equal fm_abi_version() in _parser.cc. Bump both together whenever
@@ -45,19 +56,20 @@ _ABI_VERSION = 2
 
 def _open_checked() -> Optional[ctypes.CDLL]:
     """dlopen the .so and verify every symbol exists AND the compiled-in
-    ABI version matches this wrapper. Returns None on version mismatch
-    (caller decides whether a rebuild is possible); raises AttributeError
-    on missing symbols like before."""
+    ABI version matches this wrapper. Returns None when the binary is
+    stale — wrong version OR missing symbols (a pre-versioning .so has
+    no fm_abi_version at all) — so the caller can rebuild once."""
     lib = ctypes.CDLL(_SO)
-    # Touch every symbol: a stale .so missing a newer entry point must
-    # route to the fallback path too.
-    lib.fm_abi_version
-    lib.fm_parse_block
-    lib.fm_dedup_ids
-    lib.fm_bb_new
-    lib.fm_bb_feed
-    lib.fm_bb_finish
-    lib.fm_bb_free
+    try:
+        lib.fm_abi_version
+        lib.fm_parse_block
+        lib.fm_dedup_ids
+        lib.fm_bb_new
+        lib.fm_bb_feed
+        lib.fm_bb_finish
+        lib.fm_bb_free
+    except AttributeError:
+        return None  # stale binary predating a symbol: rebuildable
     lib.fm_abi_version.restype = ctypes.c_int64
     lib.fm_abi_version.argtypes = []
     if lib.fm_abi_version() != _ABI_VERSION:
@@ -81,20 +93,20 @@ def _load() -> ctypes.CDLL:
                 _build()
             lib = _open_checked()
             if lib is None:
-                # ABI drift with source present: rebuild once and retry
-                # (an mtime-preserving deploy can leave a stale .so
-                # "newer" than the source; symbols alone can't catch
+                # Stale binary (ABI drift or missing symbols) with
+                # source present: rebuild once and retry (an
+                # mtime-preserving deploy can leave a stale .so "newer"
+                # than the source; mtime/symbol checks alone can't catch
                 # changed argument layouts — silent corruption).
                 if not os.path.exists(_SRC):
                     raise RuntimeError(
-                        f"{_SO} reports a different ABI version and no "
-                        "source is present to rebuild")
+                        f"{_SO} is a stale ABI and no source is present "
+                        "to rebuild")
                 _build()
                 lib = _open_checked()
                 if lib is None:
                     raise RuntimeError(
-                        f"{_SO} still reports a different ABI version "
-                        "after rebuild")
+                        f"{_SO} is still a stale ABI after rebuild")
         except (OSError, FileNotFoundError, AttributeError,
                 subprocess.CalledProcessError, RuntimeError) as e:
             _load_error = f"C++ parser unavailable: {e}"

@@ -1,0 +1,106 @@
+"""BASELINE config #1 measurement: train->predict->AUC on the 1M-row
+Criteo-Kaggle-like sample (data/synth.py), on whatever device is present
+(the real TPU chip under the driver).
+
+Runs the real CLI end to end, measures wall-clock training throughput
+and score-file test AUC, trains the independent NumPy SGD-FM oracle on
+the same data, and prints one JSON blob to record in BASELINE.md.
+
+Usage: python tools/criteo_bench.py [n_train] [n_test]
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(n_train: int = 1_000_000, n_test: int = 100_000) -> None:
+    import run_tffm
+    from fast_tffm_tpu.data import synth
+    from fast_tffm_tpu.metrics import exact_auc
+
+    vocab = 1 << 22
+    k, lr, epochs, lam = 8, 0.05, 2, 1e-6
+    with tempfile.TemporaryDirectory() as tmp:
+        train = os.path.join(tmp, "train.txt")
+        test = os.path.join(tmp, "test.txt")
+        t0 = time.time()
+        meta = synth.write_dataset(train, test, n_train, n_test, seed=17)
+        gen_sec = time.time() - t0
+
+        cfg_path = os.path.join(tmp, "ck.cfg")
+        with open(cfg_path, "w") as fh:
+            fh.write(f"""
+[General]
+vocabulary_size = {vocab}
+hash_feature_id = True
+factor_num = {k}
+model_file = {tmp}/model/ck
+log_file = {tmp}/log/ck.log
+
+[Train]
+train_files = {train}
+epoch_num = {epochs}
+batch_size = 8192
+learning_rate = {lr}
+factor_lambda = {lam}
+bias_lambda = {lam}
+init_value_range = 0.01
+loss_type = logistic
+max_features_per_example = 48
+bucket_ladder = 48
+shuffle = False
+
+[Predict]
+predict_files = {test}
+score_path = {tmp}/score
+""")
+        t0 = time.time()
+        assert run_tffm.main(["train", cfg_path]) == 0
+        train_sec = time.time() - t0
+        t0 = time.time()
+        assert run_tffm.main(["predict", cfg_path]) == 0
+        predict_sec = time.time() - t0
+
+        scores = np.loadtxt(os.path.join(tmp, "score", "test.txt.score"))
+        labels = np.loadtxt(test, usecols=0)
+        fw_auc = exact_auc(scores, labels)
+
+        # Independent oracle: SAME data, SAME batch size/hyperparameters
+        # (a mismatched batch size changes the step count and therefore
+        # Adagrad progress — the first run of this tool showed exactly
+        # that confound). Minutes of numpy time, once per round.
+        t0 = time.time()
+        tr = synth.parse_file_blocks(train, vocab, 8192)
+        te = synth.parse_file_blocks(test, vocab, 8192)
+        oracle_auc = exact_auc(
+            synth.numpy_fm_train_predict(tr, te, vocab, k=k, lr=lr,
+                                         epochs=epochs, factor_lambda=lam,
+                                         bias_lambda=lam),
+            labels)
+        oracle_sec = time.time() - t0
+
+    print(json.dumps({
+        "config": "baseline#1 criteo-kaggle-like",
+        "n_train": n_train, "n_test": n_test, "epochs": epochs,
+        "gen_sec": round(gen_sec, 1),
+        "train_sec": round(train_sec, 1),
+        "train_examples_per_sec": round(n_train * epochs / train_sec, 1),
+        "predict_sec": round(predict_sec, 1),
+        "test_auc": round(fw_auc, 4),
+        "oracle_auc": round(oracle_auc, 4),
+        "oracle_sec": round(oracle_sec, 1),
+        "bayes_auc": round(meta["bayes_auc"], 4),
+        "positive_rate": round(meta["positive_rate_test"], 4),
+    }))
+
+
+if __name__ == "__main__":
+    main(*(int(a) for a in sys.argv[1:]))
