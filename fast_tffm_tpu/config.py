@@ -116,6 +116,19 @@ class FmConfig:
                 raise ValueError("model_type=ffm requires field_num > 0")
             if self.order != 2:
                 raise ValueError("ffm supports order=2 only")
+            # The field-bucketed scorer's biggest intermediate is
+            # [B, F, F, k] (ops/interaction.py); warn before a config
+            # quietly asks for a multi-GB tensor per step.
+            ffm_bytes = (self.batch_size * self.field_num ** 2
+                         * self.factor_num * 4)
+            if ffm_bytes > 2 << 30:
+                import warnings
+                warnings.warn(
+                    f"ffm intermediate [batch_size, field_num^2, "
+                    f"factor_num] is {ffm_bytes / 2**30:.1f} GB per step "
+                    f"(B={self.batch_size}, F={self.field_num}, "
+                    f"k={self.factor_num}); reduce batch_size or "
+                    "field_num to fit device memory")
         if self.loss_type not in ("logistic", "mse"):
             raise ValueError(f"unknown loss_type {self.loss_type!r}")
         if self.kernel not in ("auto", "xla", "pallas"):

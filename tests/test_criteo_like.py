@@ -32,6 +32,7 @@ def dataset(tmp_path_factory):
     return tmp, train, test, meta
 
 
+@pytest.mark.slow
 def test_criteo_like_auc_parity(dataset):
     tmp, train, test, meta = dataset
     # sane generator: Criteo-like positive rate, a real signal to learn
