@@ -17,8 +17,9 @@ between rounds, the same JSON carries the attribution breakdown:
   batch, device never touched) — the input-bound ceiling,
 - ``device_only``: jitted-step rate on one cached resident batch (no host
   work, no transfer) — the compute-bound ceiling,
-- ``h2d_only``: device_put rate for one batch's arrays (~4.3 MB/step) —
-  the transfer ceiling; on a tunnelled TPU this is the usual culprit,
+- ``h2d_only``: device_put rate for one batch's actual payload (raw-ids
+  mode ships ids+vals, ~3 MB/step at L=48) — the transfer ceiling; on a
+  tunnelled TPU this is the usual culprit,
 - ``sharded_input_per_worker``: host-only rate of ONE of 2 byte-range
   shards (the multi-process fast path's per-worker input build),
   recorded so the "sharded input ~matches unsharded" claim is an
@@ -82,10 +83,18 @@ def make_cfg(path):
                     shuffle=False)
 
 
+def _raw_mode(cfg):
+    """Whether the resolved spec ships raw ids (dedup=device on the one
+    real chip) — the pipeline must build matching batches."""
+    from fast_tffm_tpu.models.fm import ModelSpec
+    return ModelSpec.from_config(cfg).dedup == "device"
+
+
 def run_e2e(cfg, step, n_warm=N_WARM):
-    """One honest end-to-end trial: file -> C++ parse -> dedup/pad -> H2D
-    -> jitted step, host pipeline prefetching ahead of the device (the
-    same loop train() runs). One timing protocol for every e2e line
+    """One honest end-to-end trial: file -> C++ parse -> build -> H2D ->
+    jitted step, host pipeline prefetching ahead of the device (the same
+    loop train() runs; dedup runs host- or device-side per the resolved
+    spec, like train() does). One timing protocol for every e2e line
     (FM headline and FFM)."""
     import jax
     from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
@@ -93,7 +102,8 @@ def run_e2e(cfg, step, n_warm=N_WARM):
                                          init_table)
     table = init_table(cfg, 0)
     acc = init_accumulator(cfg)
-    it = prefetch(batch_iterator(cfg, cfg.train_files, training=True),
+    it = prefetch(batch_iterator(cfg, cfg.train_files, training=True,
+                                 raw_ids=_raw_mode(cfg)),
                   depth=4)
     t0 = None
     n = 0
@@ -107,14 +117,19 @@ def run_e2e(cfg, step, n_warm=N_WARM):
     return (n - n_warm) * cfg.batch_size / (time.perf_counter() - t0)
 
 
-def run_host_only(cfg, shard_index=0, num_shards=1):
-    """Pipeline-only rate: consume every batch, never touch the device."""
+def run_host_only(cfg, shard_index=0, num_shards=1, raw_ids=None):
+    """Pipeline-only rate: consume every batch, never touch the device.
+    Defaults to the same raw/dedup build mode the e2e loop uses;
+    sharded callers pass raw_ids=False (multi-process mode requires the
+    host-dedup build, so that metric must measure it)."""
     from fast_tffm_tpu.data.pipeline import batch_iterator
+    if raw_ids is None:
+        raw_ids = _raw_mode(cfg)
     n_ex = 0
     t0 = time.perf_counter()
     for batch in batch_iterator(cfg, cfg.train_files, training=True,
                                 shard_index=shard_index,
-                                num_shards=num_shards):
+                                num_shards=num_shards, raw_ids=raw_ids):
         n_ex += batch.num_real
     return n_ex / (time.perf_counter() - t0)
 
@@ -127,8 +142,10 @@ def run_device_only(cfg, step):
     from fast_tffm_tpu.data.pipeline import batch_iterator
     from fast_tffm_tpu.models.fm import (batch_args, init_accumulator,
                                          init_table)
-    batch = next(batch_iterator(cfg, cfg.train_files, training=True))
-    args = {k: jax.device_put(v) for k, v in batch_args(batch).items()}
+    batch = next(batch_iterator(cfg, cfg.train_files, training=True,
+                                raw_ids=_raw_mode(cfg)))
+    args = {k: (jax.device_put(v) if v is not None else None)
+            for k, v in batch_args(batch).items()}
     table = init_table(cfg, 0)
     acc = init_accumulator(cfg)
     for _ in range(N_WARM):
@@ -175,16 +192,18 @@ def run_ffm_e2e(tmp):
 
 def run_h2d_only(cfg):
     """Transfer-only rate: device_put one batch's host arrays per step
-    (the per-step H2D traffic, ~4.3 MB at these shapes), nothing else."""
+    (the per-step H2D traffic — ~3 MB at L=48 in raw-ids mode, which
+    drops the uniq_ids array), nothing else."""
     import jax
     from fast_tffm_tpu.data.pipeline import batch_iterator
     from fast_tffm_tpu.models.fm import batch_args
-    batch = next(batch_iterator(cfg, cfg.train_files, training=True))
-    args = batch_args(batch)
-    jax.block_until_ready(jax.device_put(list(args.values())))
+    batch = next(batch_iterator(cfg, cfg.train_files, training=True,
+                                raw_ids=_raw_mode(cfg)))
+    payload = [v for v in batch_args(batch).values() if v is not None]
+    jax.block_until_ready(jax.device_put(payload))
     t0 = time.perf_counter()
     for _ in range(N_TIMED):
-        jax.block_until_ready(jax.device_put(list(args.values())))
+        jax.block_until_ready(jax.device_put(payload))
     return N_TIMED * B / (time.perf_counter() - t0)
 
 
@@ -211,7 +230,8 @@ def main():
         h2d = run_h2d_only(cfg)
         # Per-worker input rate of the 2-way byte-range sharded fast path
         # (what each process's pipeline sustains in multi-process mode).
-        shard = run_host_only(cfg, shard_index=0, num_shards=2)
+        shard = run_host_only(cfg, shard_index=0, num_shards=2,
+                              raw_ids=False)
         ffm = run_ffm_e2e(tmp)
 
     eps = statistics.median(e2e)

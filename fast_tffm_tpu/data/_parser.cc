@@ -339,8 +339,9 @@ extern "C" {
 // .so reporting a different version: the mtime/symbol checks alone
 // cannot catch a stale binary whose symbols still exist but whose
 // argument layouts moved (silent data corruption, not a load error).
-// History: 1 = initial; 2 = field-aware (FFM) params + fields buffers.
-int64_t fm_abi_version() { return 2; }
+// History: 1 = initial; 2 = field-aware (FFM) params + fields buffers;
+// 3 = raw_ids builder mode (dedup=device).
+int64_t fm_abi_version() { return 3; }
 
 // Returns 0 on success. Outputs:
 //   labels[n_examples], poses[n_examples+1], ids[nnz], vals[nnz]
@@ -453,6 +454,7 @@ struct BatchBuilder {
   int64_t B, L, vocab;
   bool hash_ids;
   bool field_aware = false;  // FFM `field:fid[:val]` tokens
+  bool raw_ids = false;      // dedup=device: li holds raw ids, no dedup
   int64_t field_num = 0;
   int max_feats;
   int64_t max_uniq;  // 0 = unlimited; else batch closes BEFORE exceeding
@@ -480,7 +482,10 @@ void bb_reset(BatchBuilder* bb) {
   bb->n_uniq = 1;
   bb->max_nnz = 0;
   bb->cur_stamp++;
-  std::memset(bb->li.data(), 0, size_t(bb->B * bb->L) * sizeof(int32_t));
+  // Raw mode: padding cells hold the raw pad id (== vocab, the dead
+  // row) — there is no "pad slot 0" indirection without a unique table.
+  std::fill(bb->li.begin(), bb->li.end(),
+            bb->raw_ids ? int32_t(bb->vocab) : 0);
   std::memset(bb->vals.data(), 0, size_t(bb->B * bb->L) * sizeof(float));
   if (bb->field_aware) {
     std::memset(bb->fields.data(), 0,
@@ -518,16 +523,20 @@ inline void bb_rollback_line(BatchBuilder* bb, int32_t saved_uniq) {
 extern "C" {
 
 void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
-                int field_aware, int64_t field_num, int max_feats,
-                int64_t max_uniq) {
+                int field_aware, int64_t field_num, int raw_ids,
+                int max_feats, int64_t max_uniq) {
   if (B <= 0 || L <= 0 || vocab <= 0) return nullptr;
   if (field_aware != 0 && field_num <= 0) return nullptr;
+  // raw_ids skips dedup entirely; the fixed-U spill protocol needs the
+  // dedup table, so the two are mutually exclusive.
+  if (raw_ids != 0 && max_uniq != 0) return nullptr;
   auto* bb = new BatchBuilder();
   bb->B = B;
   bb->L = L;
   bb->vocab = vocab;
   bb->hash_ids = hash_ids != 0;
   bb->field_aware = field_aware != 0;
+  bb->raw_ids = raw_ids != 0;
   bb->field_num = field_num;
   bb->max_feats = (max_feats > 0 && max_feats < L) ? max_feats : int(L);
   // A single line adds <= max_feats uniques (+ the pad slot), so the cap
@@ -540,7 +549,7 @@ void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
   bb->labels.resize(size_t(B));
   bb->uniq.resize(size_t(B * L + 1));
   bb->uniq[0] = int32_t(vocab);  // pad slot
-  bb->li.assign(size_t(B * L), 0);
+  bb->li.assign(size_t(B * L), bb->raw_ids ? int32_t(vocab) : 0);
   bb->vals.assign(size_t(B * L), 0.0f);
   if (bb->field_aware) bb->fields.assign(size_t(B * L), 0);
   size_t cap = 16;
@@ -611,7 +620,7 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
                       (long long)bb->lineno, terr.c_str());
         return -1;
       }
-      irow[n_feats] = bb_slot(bb, t.row);
+      irow[n_feats] = bb->raw_ids ? t.row : bb_slot(bb, t.row);
       vrow[n_feats] = t.val;
       if (frow != nullptr) frow[n_feats] = t.field;
       n_feats++;

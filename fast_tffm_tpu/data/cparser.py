@@ -51,7 +51,7 @@ def _build() -> None:
 
 # Must equal fm_abi_version() in _parser.cc. Bump both together whenever
 # an exported signature changes.
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 
 
 def _open_checked() -> Optional[ctypes.CDLL]:
@@ -137,6 +137,7 @@ def _load() -> ctypes.CDLL:
         lib.fm_bb_new.argtypes = [ctypes.c_int64, ctypes.c_int64,
                                   ctypes.c_int64, ctypes.c_int,
                                   ctypes.c_int, ctypes.c_int64,  # field flag, count
+                                  ctypes.c_int,                  # raw_ids
                                   ctypes.c_int, ctypes.c_int64]
         lib.fm_bb_free.argtypes = [ctypes.c_void_p]
         lib.fm_bb_feed.restype = ctypes.c_int
@@ -216,20 +217,26 @@ class BatchBuilder:
     def __init__(self, batch_size: int, max_cols: int,
                  vocabulary_size: int, hash_feature_id: bool = False,
                  field_aware: bool = False, field_num: int = 0,
+                 raw_ids: bool = False,
                  max_features_per_example: int = 0, max_uniq: int = 0):
         """``max_uniq`` > 0 caps the batch's unique-row count (incl. the
         pad slot): a line that would exceed it closes the batch early
         (spill) and opens the next one — the fixed-U protocol for
         multi-process SPMD. Must exceed the per-example feature cap.
         ``field_aware`` parses FFM ``field:fid[:val]`` tokens and makes
-        ``finish()`` return a fields array."""
+        ``finish()`` return a fields array. ``raw_ids`` (dedup=device)
+        skips the dedup pass: local_idx holds raw feature ids (pad cells
+        = vocabulary_size) and finish() returns uniq=None; incompatible
+        with max_uniq."""
         self._lib = _load()
         self.B, self.L = batch_size, max_cols
         self.field_aware = field_aware
+        self.raw_ids = raw_ids
         self._h = self._lib.fm_bb_new(batch_size, max_cols,
                                       vocabulary_size,
                                       int(hash_feature_id),
                                       int(field_aware), field_num,
+                                      int(raw_ids),
                                       max_features_per_example,
                                       max_uniq)
         if not self._h:
@@ -270,7 +277,9 @@ class BatchBuilder:
         n = self._lib.fm_bb_finish(self._h, labels, uniq, li, vals, fields,
                                    ctypes.byref(n_uniq),
                                    ctypes.byref(max_nnz))
-        return (int(n), labels, uniq[:n_uniq.value].copy(), li, vals,
+        return (int(n), labels,
+                None if self.raw_ids else uniq[:n_uniq.value].copy(),
+                li, vals,
                 fields if self.field_aware else None, int(max_nnz.value))
 
     def __del__(self):

@@ -109,11 +109,15 @@ def effective_L_cap(cfg: FmConfig) -> int:
 @dataclasses.dataclass
 class DeviceBatch:
     """One fixed-shape batch. Shapes: B examples, L feature slots per
-    example, U unique-row slots."""
+    example, U unique-row slots.
+
+    Raw-ids mode (``dedup = device``): ``uniq_ids`` is None and
+    ``local_idx`` holds RAW feature ids (pad cells = pad_id); the jitted
+    step runs the unique pass on device (models/fm._device_dedup)."""
     labels: np.ndarray       # f32 [B]
     weights: np.ndarray      # f32 [B]; 0.0 marks padded dummy examples
-    uniq_ids: np.ndarray     # i32 [U]; padded with pad_id, last slot pad
-    local_idx: np.ndarray    # i32 [B, L]; indexes uniq_ids; pad -> U-1
+    uniq_ids: Optional[np.ndarray]  # i32 [U]; pad_id padding; None = raw
+    local_idx: np.ndarray    # i32 [B, L]; indexes uniq_ids (or raw ids)
     vals: np.ndarray         # f32 [B, L]; 0.0 padding
     fields: Optional[np.ndarray] = None  # i32 [B, L]; 0 padding (FFM)
     num_real: int = 0        # examples that are not padding
@@ -121,7 +125,8 @@ class DeviceBatch:
     @property
     def shape_key(self) -> Tuple[int, int, int, bool]:
         return (len(self.labels), self.local_idx.shape[1],
-                len(self.uniq_ids), self.fields is not None)
+                len(self.uniq_ids) if self.uniq_ids is not None else 0,
+                self.fields is not None)
 
 
 def expand_files(patterns: Sequence[str]) -> List[str]:
@@ -168,7 +173,8 @@ def make_device_batch(block: ParsedBlock, cfg: FmConfig,
                       weights: Optional[np.ndarray] = None,
                       batch_size: Optional[int] = None,
                       fixed_shape: bool = False,
-                      uniq_bucket: int = 0) -> DeviceBatch:
+                      uniq_bucket: int = 0,
+                      raw_ids: bool = False) -> DeviceBatch:
     """CSR block -> fixed-shape DeviceBatch (pad + host-side unique).
 
     ``fixed_shape`` pins L and U instead of fitting this batch —
@@ -178,11 +184,18 @@ def make_device_batch(block: ParsedBlock, cfg: FmConfig,
     program). ``uniq_bucket`` (fixed_shape only) pins U to a measured
     density bound instead of the worst-case ladder top — raising
     UniqOverflow when the block genuinely exceeds it (spill protocol).
+
+    ``raw_ids`` (dedup=device mode, incompatible with fixed_shape):
+    skip the host unique pass entirely — local_idx holds raw ids,
+    uniq_ids is None, the device runs the unique.
     """
     B = batch_size or cfg.batch_size
     n_real = block.batch_size
     if n_real > B:
         raise ValueError(f"block of {n_real} examples exceeds batch_size {B}")
+    if raw_ids and fixed_shape:
+        raise ValueError("raw_ids (dedup=device) has no fixed-U protocol; "
+                         "multi-process mode needs dedup=host")
     sizes = block.sizes
     max_l = int(sizes.max()) if n_real else 1
     ladder = cfg.bucket_ladder
@@ -192,25 +205,28 @@ def make_device_batch(block: ParsedBlock, cfg: FmConfig,
                          f"bucket {L}; raise bucket_ladder or "
                          "max_features_per_example")
 
-    # Host-side unique (replaces the reference's in-graph tf.unique).
-    try:
-        from fast_tffm_tpu.data.cparser import dedup_ids_fast
-        uniq, inverse = dedup_ids_fast(block.ids)
-    except RuntimeError:  # C++ extension unavailable
-        uniq, inverse = np.unique(block.ids, return_inverse=True)
-    uladder = _uniq_ladder(B, L)
-    if fixed_shape:
-        U = uniq_bucket or uladder[-1]
-        if len(uniq) + 1 > U:
-            raise UniqOverflow(
-                f"{len(uniq)} unique ids exceed the fixed unique bucket "
-                f"{U} (one slot is reserved for padding)")
+    if raw_ids:
+        uniq_ids, inverse, pad_slot = None, block.ids, cfg.pad_id
     else:
-        U = _ladder_fit(len(uniq) + 1, uladder)
+        # Host-side unique (replaces the reference's in-graph tf.unique).
+        try:
+            from fast_tffm_tpu.data.cparser import dedup_ids_fast
+            uniq, inverse = dedup_ids_fast(block.ids)
+        except RuntimeError:  # C++ extension unavailable
+            uniq, inverse = np.unique(block.ids, return_inverse=True)
+        uladder = _uniq_ladder(B, L)
+        if fixed_shape:
+            U = uniq_bucket or uladder[-1]
+            if len(uniq) + 1 > U:
+                raise UniqOverflow(
+                    f"{len(uniq)} unique ids exceed the fixed unique "
+                    f"bucket {U} (one slot is reserved for padding)")
+        else:
+            U = _ladder_fit(len(uniq) + 1, uladder)
 
-    uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
-    uniq_ids[:len(uniq)] = uniq
-    pad_slot = U - 1  # always a pad_id slot by construction
+        uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
+        uniq_ids[:len(uniq)] = uniq
+        pad_slot = U - 1  # always a pad_id slot by construction
 
     local_idx = np.full((B, L), pad_slot, dtype=np.int32)
     vals = np.zeros((B, L), dtype=np.float32)
@@ -379,14 +395,17 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
             vals = np.ascontiguousarray(vals[:, :L])
             if fields is not None:
                 fields = np.ascontiguousarray(fields[:, :L])
-        if fixed_shape and uniq_bucket:
-            U = uniq_bucket  # builder guarantees len(uniq) <= U
+        if uniq is None:  # raw-ids mode: li holds raw ids, no unique set
+            uniq_ids = None
         else:
-            uladder = _uniq_ladder(B, L)
-            U = uladder[-1] if fixed_shape else _ladder_fit(len(uniq) + 1,
-                                                            uladder)
-        uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
-        uniq_ids[:len(uniq)] = uniq  # slot 0 already pad_id (C++ layout)
+            if fixed_shape and uniq_bucket:
+                U = uniq_bucket  # builder guarantees len(uniq) <= U
+            else:
+                uladder = _uniq_ladder(B, L)
+                U = (uladder[-1] if fixed_shape
+                     else _ladder_fit(len(uniq) + 1, uladder))
+            uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
+            uniq_ids[:len(uniq)] = uniq  # slot 0 already pad_id (C++)
         weights = np.zeros(B, np.float32)
         weights[:n] = 1.0
         labels[n:] = 0.0  # C++ buffer may hold stale labels past n
@@ -454,7 +473,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    keep_empty: bool = False,
                    fixed_shape: bool = False,
                    uniq_bucket: int = 0,
-                   stats: Optional[SpillStats] = None
+                   stats: Optional[SpillStats] = None,
+                   raw_ids: bool = False
                    ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files.
 
@@ -465,6 +485,9 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
     ``uniq_bucket`` (fixed_shape mode): fixed unique-row count per batch
     — see probe_uniq_bucket. Overfull batches spill: they close early
     with fewer real examples and the remainder opens the next batch.
+
+    ``raw_ids`` (dedup=device): skip the host unique pass; batches carry
+    raw ids in local_idx and uniq_ids=None (models/fm dedups on device).
     """
     from fast_tffm_tpu.data.parser import parse_lines
     from fast_tffm_tpu.data.cparser import parse_lines_fast
@@ -476,6 +499,9 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
     rng = random.Random(cfg.seed if seed is None else seed)
     do_shuffle = training and cfg.shuffle
     uniq_bucket = uniq_bucket or cfg.uniq_bucket
+    if raw_ids and fixed_shape:
+        raise ValueError("raw_ids (dedup=device) has no fixed-U protocol; "
+                         "multi-process mode needs dedup=host")
 
     # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
     # no feature needs per-line Python handling — including sharded
@@ -495,6 +521,7 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                               hash_feature_id=cfg.hash_feature_id,
                               field_aware=cfg.model_type == "ffm",
                               field_num=cfg.field_num,
+                              raw_ids=raw_ids,
                               max_features_per_example=(
                                   cfg.max_features_per_example),
                               max_uniq=(uniq_bucket if fixed_shape else 0))
@@ -525,7 +552,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                     out = make_device_batch(block, cfg, weights=w,
                                             batch_size=B,
                                             fixed_shape=fixed_shape,
-                                            uniq_bucket=uniq_bucket)
+                                            uniq_bucket=uniq_bucket,
+                                            raw_ids=raw_ids)
                     if stats is not None:
                         stats.count(out.num_real, B, False)
                     yield out

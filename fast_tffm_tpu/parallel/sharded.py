@@ -77,6 +77,16 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
     return Mesh(grid, ("data", "model"))
 
 
+def _require_host_dedup(spec: ModelSpec) -> None:
+    """Mesh steps consume the host-side unique contract (uniq_ids with
+    fixed buckets; global_batch offsets local_idx into the concatenated
+    unique axis) — a raw-ids spec here would feed garbage indices."""
+    if spec.dedup == "device":
+        raise ValueError(
+            "dedup = device is single-device only; mesh paths require "
+            "dedup = host (auto resolves this correctly)")
+
+
 # kernel='pallas' on a mesh: GSPMD has no partitioning rule for a
 # pallas_call custom call, so the step bodies wrap the kernel in
 # shard_map over the data axis when given the mesh (models/fm._scores,
@@ -111,6 +121,7 @@ def make_sharded_train_step(spec: ModelSpec, mesh: Mesh,
     the whole mesh, loss replicated. Cached per (spec, mesh)."""
     if with_fields is None:
         with_fields = spec.model_type == "ffm"
+    _require_host_dedup(spec)
     in_sh, out_sh = _shardings(mesh, with_fields)
     fn = functools.partial(train_step_body, spec, mesh=mesh)
     jitted = jax.jit(fn, in_shardings=in_sh, out_shardings=out_sh,
@@ -134,6 +145,7 @@ def make_sharded_score_fn(spec: ModelSpec, mesh: Mesh,
     """Sharded inference: row-sharded table in, batch-sharded scores out."""
     if with_fields is None:
         with_fields = spec.model_type == "ffm"
+    _require_host_dedup(spec)
     row, vec, mat, _ = _layout(mesh)
     in_sh = [row, vec, mat, mat] + ([mat] if with_fields else [])
 

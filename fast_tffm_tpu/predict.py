@@ -21,7 +21,7 @@ from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
                                          prefetch)
 from fast_tffm_tpu.metrics import sigmoid
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
-                                     make_batch_scorer)
+                                     make_batch_scorer, ships_raw_batches)
 from fast_tffm_tpu.utils.logging import get_logger
 
 
@@ -59,11 +59,13 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     and only [U, D] blocks reach the device (``table`` is unused)."""
     spec = ModelSpec.from_config(cfg)
     score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
+    raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
     out: List[np.ndarray] = []
     # keep_empty: blank input lines become zero-feature examples so the
     # score file stays line-aligned with the input (SURVEY §3.4).
     for batch in prefetch(batch_iterator(cfg, files, training=False,
-                                         epochs=1, keep_empty=True)):
+                                         epochs=1, keep_empty=True,
+                                         raw_ids=raw)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         scores = score_fn(table, args)

@@ -26,7 +26,7 @@ from fast_tffm_tpu.data.pipeline import (SPILL_WARN_FRACTION, SpillStats,
 from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
-                                     make_train_step)
+                                     make_train_step, ships_raw_batches)
 from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.timing import StepTimer, trace_span
 
@@ -40,11 +40,12 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     table (``table`` is then unused)."""
     spec = ModelSpec.from_config(cfg)
     score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
+    raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
     auc = StreamingAUC()
     n = 0
     n_batches = 0
     for batch in prefetch(batch_iterator(cfg, files, training=False,
-                                         epochs=1)):
+                                         epochs=1, raw_ids=raw)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         scores = score_fn(table, args)
@@ -145,6 +146,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     if multi_process:
         from fast_tffm_tpu.data.pipeline import require_bounded_examples
         require_bounded_examples(cfg, "multi-process training")
+    raw_mode = spec.dedup == "device"
+    if raw_mode and (mesh is not None or multi_process):
+        # Unreachable via dedup=auto (it resolves to host whenever more
+        # than one device exists); an explicit config gets a clear error.
+        raise ValueError(
+            "dedup = device is single-device only: mesh and multi-process "
+            "paths rely on the host-side unique contract (fixed-U "
+            "buckets, global_batch local_idx offsets)")
 
     uniq_bucket = 0
     if multi_process:
@@ -275,7 +284,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 weight_files=cfg.weight_files, shard_index=shard_index,
                 num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
                 fixed_shape=multi_process, uniq_bucket=uniq_bucket,
-                stats=epoch_stats))
+                stats=epoch_stats, raw_ids=raw_mode))
             while True:
                 batch = next(it, None)
                 if multi_process:
