@@ -81,16 +81,46 @@ inline bool is_ws(char c) {
 // rounding matches the Python parser's float(token) -> np.float32 exactly
 // (strtof's direct-to-float rounding can differ in double-rounding
 // corners, so the double route is the parity-correct one).
+//
+// Lexical grammar is pinned to PYTHON's float() (the golden-parity
+// contract), which is narrower than strtod's: no hex floats ("0x10"),
+// no "nan(chars)" payloads — only decimal literals and the inf/infinity/
+// nan words. Overflow reads as +-inf like Python (strtod flags ERANGE);
+// underflow reads as a denormal/0 like Python (ERANGE ignored there).
 bool parse_float_slow(const char* begin, const char* end, float* out) {
   char buf[64];
   size_t n = size_t(end - begin);
-  if (n >= sizeof(buf)) return false;
+  if (n >= sizeof(buf) || n == 0) return false;
+  bool word_ok = false;  // [+-]?(inf|infinity|nan), case-insensitive
+  {
+    const char* p = begin;
+    if (*p == '+' || *p == '-') p++;
+    char low[16];
+    size_t m = size_t(end - p);
+    if (m > 0 && m < sizeof(low)) {
+      for (size_t i = 0; i < m; i++) {
+        low[i] = char(std::tolower((unsigned char)p[i]));
+      }
+      low[m] = '\0';
+      word_ok = !std::strcmp(low, "inf") || !std::strcmp(low, "infinity") ||
+                !std::strcmp(low, "nan");
+    }
+  }
+  if (!word_ok) {
+    for (const char* p = begin; p < end; p++) {
+      char c = *p;
+      if (!((c >= '0' && c <= '9') || c == '.' || c == '+' || c == '-' ||
+            c == 'e' || c == 'E')) {
+        return false;  // hex floats, nan payloads, garbage
+      }
+    }
+  }
   std::memcpy(buf, begin, n);
   buf[n] = '\0';
   char* endp = nullptr;
   errno = 0;
   double v = std::strtod(buf, &endp);
-  if (endp != buf + n || errno == ERANGE) return false;
+  if (endp != buf + n) return false;
   *out = float(v);
   return true;
 }

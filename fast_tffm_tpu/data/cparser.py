@@ -34,10 +34,11 @@ _load_error: Optional[str] = None
 
 def _build() -> None:
     # Build to a temp name and os.replace: atomic for concurrent
-    # processes, and the fresh inode means a retry dlopen after an
-    # ABI-mismatch rebuild maps the NEW library (dlopen dedups by
-    # dev/inode — rebuilding in place would both hand back the stale
-    # mapping and rewrite a live mmap).
+    # processes, and never rewrites a live mmap in place. NOTE this does
+    # NOT make a same-path retry dlopen see the new library — glibc
+    # dedups by pathname before stat'ing the inode — which is why
+    # _load's ABI-mismatch retry opens the rebuilt file through a
+    # one-off path.
     tmp = f"{_SO}.tmp.{os.getpid()}"
     cmd = ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
            "-pthread", "-o", tmp, _SRC]
@@ -54,12 +55,12 @@ def _build() -> None:
 _ABI_VERSION = 3
 
 
-def _open_checked() -> Optional[ctypes.CDLL]:
+def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
     """dlopen the .so and verify every symbol exists AND the compiled-in
     ABI version matches this wrapper. Returns None when the binary is
     stale — wrong version OR missing symbols (a pre-versioning .so has
     no fm_abi_version at all) — so the caller can rebuild once."""
-    lib = ctypes.CDLL(_SO)
+    lib = ctypes.CDLL(path or _SO)
     try:
         lib.fm_abi_version
         lib.fm_parse_block
@@ -103,7 +104,17 @@ def _load() -> ctypes.CDLL:
                         f"{_SO} is a stale ABI and no source is present "
                         "to rebuild")
                 _build()
-                lib = _open_checked()
+                # dlopen dedups by PATHNAME before inode: re-opening _SO
+                # would hand back the stale mapping we just probed. Open
+                # the rebuilt library through a one-off path instead
+                # (the mapping survives the unlink).
+                import shutil
+                reload_path = f"{_SO}.reload.{os.getpid()}"
+                shutil.copy2(_SO, reload_path)
+                try:
+                    lib = _open_checked(reload_path)
+                finally:
+                    os.unlink(reload_path)
                 if lib is None:
                     raise RuntimeError(
                         f"{_SO} is still a stale ABI after rebuild")

@@ -49,6 +49,24 @@ class ParseError(ValueError):
     pass
 
 
+def _strict_float(s: str) -> float:
+    """float(s) minus Python-only lexical extensions: PEP 515 underscore
+    separators ("1_0" == 10) and non-ASCII Unicode digits are not part
+    of the libsvm number format and the C++ parser (like the reference's
+    strtod) rejects them — golden parity requires the Python fallback to
+    reject them too."""
+    if "_" in s or not s.isascii():
+        raise ValueError(s)
+    return float(s)
+
+
+def _strict_int(s: str) -> int:
+    """int(s) minus PEP 515 underscores / Unicode digits (_strict_float)."""
+    if "_" in s or not s.isascii():
+        raise ValueError(s)
+    return int(s)
+
+
 def parse_lines(lines: Sequence[str], vocabulary_size: int,
                 hash_feature_id: bool = False,
                 field_aware: bool = False,
@@ -77,7 +95,7 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
                 poses.append(len(ids))
             continue
         try:
-            label = float(toks[0])
+            label = _strict_float(toks[0])
         except ValueError:
             raise ParseError(f"line {lineno}: bad label {toks[0]!r}")
         labels.append(label)
@@ -96,7 +114,7 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
                         f"line {lineno}: bad ffm token {tok!r} "
                         "(want field:fid[:val])")
                 try:
-                    fld = int(fld_s)
+                    fld = _strict_int(fld_s)
                 except ValueError:
                     raise ParseError(f"line {lineno}: bad field {fld_s!r}")
                 if not 0 <= fld < field_num:
@@ -116,7 +134,7 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
                 fid = hash_feature(fid_s, vocabulary_size)
             else:
                 try:
-                    fid = int(fid_s)
+                    fid = _strict_int(fid_s)
                 except ValueError:
                     raise ParseError(
                         f"line {lineno}: non-integer feature id {fid_s!r} "
@@ -129,7 +147,7 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
                 val = 1.0
             else:
                 try:
-                    val = float(val_s)
+                    val = _strict_float(val_s)
                 except ValueError:
                     raise ParseError(f"line {lineno}: bad value {val_s!r}")
             ids.append(fid)

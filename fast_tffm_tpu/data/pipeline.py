@@ -256,6 +256,27 @@ def make_device_batch(block: ParsedBlock, cfg: FmConfig,
                        num_real=n_real)
 
 
+def epoch_file_order(files: List[str], shuffle: bool, seed: int,
+                     epoch: int) -> List[str]:
+    """Per-epoch file visit order: shuffled when shuffling is on (the
+    reference's filename queue shuffles file order each epoch — SURVEY
+    §2 "Input pipeline"; the bounded line/batch shuffle alone never
+    mixes ACROSS files, so time-ordered multi-file datasets would feed
+    whole files in sequence forever).
+
+    Drawn from a DEDICATED per-(seed, epoch) Random — never the stream
+    rng:
+    that rng advances at a shard-data-dependent rate (shuffle window
+    draws per emitted batch), so sharing it would give different
+    processes different file orders by epoch 2 and break multi-process
+    lockstep."""
+    if not shuffle or len(files) < 2:
+        return files
+    out = list(files)
+    random.Random(f"{seed}/{epoch}").shuffle(out)
+    return out
+
+
 def shard_byte_range(path: str, shard_index: int,
                      num_shards: int) -> Tuple[int, int]:
     """This shard's byte range of ``path``: worker i owns every line
@@ -447,8 +468,9 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
             yield from drain(emit(*out, spilled=out[0] < B))
         tail = data[off:]  # unconsumed partial line, re-fed next chunk
 
-    for _ in range(n_epochs):
-        for path in files:
+    file_seed = cfg.seed if seed is None else seed
+    for epoch in range(n_epochs):
+        for path in epoch_file_order(files, shuffle, file_seed, epoch):
             start, end = shard_byte_range(path, shard_index, num_shards)
             tail = b""
             for chunk in _iter_owned_chunks(path, start, end):
@@ -537,7 +559,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
     # the Python parser implements that.
     parse = None if keep_empty else parse_lines_fast
 
-    for _ in range(n_epochs):
+    file_seed = cfg.seed if seed is None else seed
+    for epoch in range(n_epochs):
         pending: List[Tuple[str, float]] = []
         buf: List[Tuple[str, float]] = []
 
@@ -577,9 +600,11 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                         stats.count(out.num_real, B, True)
                     yield out
 
-        for item in _iter_lines(files, weight_files if training else (),
-                                shard_index, num_shards,
-                                keep_empty=keep_empty):
+        for item in _iter_lines(
+                epoch_file_order(files, do_shuffle and not weight_files,
+                                 file_seed, epoch),
+                weight_files if training else (),
+                shard_index, num_shards, keep_empty=keep_empty):
             if do_shuffle:
                 buf.append(item)
                 if len(buf) >= max(cfg.queue_size, B):

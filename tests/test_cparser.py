@@ -1,6 +1,8 @@
 """C++ parser vs Python parser: bit-identical outputs on the same input
 (the golden-parity contract both docstrings promise)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -157,3 +159,63 @@ def test_zero_padded_ids_parse_like_python():
     b = parse_lines(lines, 100)
     assert a.ids.tolist() == b.ids.tolist() == [5, 7]
     assert a.vals.tolist() == b.vals.tolist()
+
+
+@pytest.mark.slow
+def test_stale_so_missing_symbols_rebuilds(tmp_path, monkeypatch):
+    """A stale .so whose mtime postdates the source (mtime-preserving
+    deploy) but which predates the current symbols/ABI must trigger a
+    rebuild from source, not silent fallback — the loader's
+    fm_abi_version contract."""
+    import shutil
+    import subprocess
+    # A decoy library with none of our symbols plays the "old binary".
+    src = tmp_path / "decoy.cc"
+    src.write_text('extern "C" int decoy() { return 1; }\n')
+    decoy = tmp_path / "decoy.so"
+    subprocess.run(["g++", "-shared", "-fPIC", "-o", str(decoy), str(src)],
+                   check=True, capture_output=True)
+    so = tmp_path / "_parser.so"
+    shutil.copy(cparser._SRC, tmp_path / "_parser.cc")
+    shutil.copy(decoy, so)
+    # Make the stale .so look NEWER than the source.
+    future = os.path.getmtime(tmp_path / "_parser.cc") + 10
+    os.utime(so, (future, future))
+
+    monkeypatch.setattr(cparser, "_SO", str(so))
+    monkeypatch.setattr(cparser, "_SRC", str(tmp_path / "_parser.cc"))
+    monkeypatch.setattr(cparser, "_lib", None)
+    monkeypatch.setattr(cparser, "_load_error", None)
+    lib = cparser._load()
+    assert lib.fm_abi_version() == cparser._ABI_VERSION
+
+
+@pytest.mark.slow
+def test_abi_version_mismatch_refuses(tmp_path, monkeypatch):
+    """If even a rebuild can't produce the expected ABI (wrapper and
+    source disagree), the loader must refuse — never run mismatched
+    argument layouts."""
+    import shutil
+    so = tmp_path / "_parser.so"
+    shutil.copy(cparser._SRC, tmp_path / "_parser.cc")
+    monkeypatch.setattr(cparser, "_SO", str(so))
+    monkeypatch.setattr(cparser, "_SRC", str(tmp_path / "_parser.cc"))
+    monkeypatch.setattr(cparser, "_lib", None)
+    monkeypatch.setattr(cparser, "_load_error", None)
+    monkeypatch.setattr(cparser, "_ABI_VERSION", 999)
+    with pytest.raises(RuntimeError, match="stale ABI"):
+        cparser._load()
+
+
+def test_float_grammar_parity_edges():
+    """Lexical edges where Python float() and strtod historically
+    disagree: hex floats and nan payloads rejected, overflow reads as
+    inf, underflow as ~0 — identical on both parsers."""
+    assert_parity(["1 1:1e400 2:-1e400 3:1e-400 4:Infinity 5:NAN 6:inf"],
+                  10)
+    for bad in (["1 1:0x10"], ["1 1:nan(box)"], ["1 1:1_0"], ["0x1 1:1"],
+                ["1 1:infin"]):
+        with pytest.raises(ParseError):
+            parse_lines(bad, 10)
+        with pytest.raises(ParseError):
+            cparser.parse_lines_fast(bad, 10)
