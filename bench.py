@@ -26,7 +26,9 @@ between rounds, the same JSON carries the attribution breakdown:
   artifact, not a commit message,
 - ``ffm_e2e``: end-to-end rate of the field-aware model (BASELINE
   config #3 shapes: Avazu-like ~24 fields, k=4) through the same C++
-  fast path — FFM's own bench line.
+  fast path — FFM's own bench line,
+- ``order3_e2e``: end-to-end rate of the order-3 ANOVA-kernel FM
+  (BASELINE config #4 shapes) — the higher-order capability's line.
 
 Whichever of host_only/device_only sits near the e2e number names the
 bottleneck; a regression that moves e2e but neither ceiling is noise.
@@ -190,6 +192,23 @@ def run_ffm_e2e(tmp):
     return run_e2e(cfg, step, n_warm=n_warm)
 
 
+def run_order3_e2e(tmp):
+    """One compact order-3 FM end-to-end trial (config #4 shapes), same
+    timing protocol as the headline (run_e2e). Reuses the FM data file
+    already in ``tmp``."""
+    import os
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
+    cfg = FmConfig(vocabulary_size=1 << 20, factor_num=8, order=3,
+                   batch_size=4096, learning_rate=0.05,
+                   factor_lambda=1e-6, bias_lambda=1e-6,
+                   max_features_per_example=48, bucket_ladder=(48,),
+                   train_files=(os.path.join(tmp, "train.txt"),),
+                   shuffle=False)
+    step = make_train_step(ModelSpec.from_config(cfg))
+    return run_e2e(cfg, step, n_warm=3)
+
+
 def run_h2d_only(cfg):
     """Transfer-only rate: device_put one batch's host arrays per step
     (the per-step H2D traffic — ~3 MB at L=48 in raw-ids mode, which
@@ -233,6 +252,7 @@ def main():
         shard = run_host_only(cfg, shard_index=0, num_shards=2,
                               raw_ids=False)
         ffm = run_ffm_e2e(tmp)
+        order3 = run_order3_e2e(tmp)
 
     eps = statistics.median(e2e)
     print(json.dumps({
@@ -246,6 +266,7 @@ def main():
         "h2d_only": round(h2d, 1),
         "sharded_input_per_worker": round(shard, 1),
         "ffm_e2e": round(ffm, 1),
+        "order3_e2e": round(order3, 1),
     }))
 
 

@@ -370,8 +370,10 @@ extern "C" {
 // cannot catch a stale binary whose symbols still exist but whose
 // argument layouts moved (silent data corruption, not a load error).
 // History: 1 = initial; 2 = field-aware (FFM) params + fields buffers;
-// 3 = raw_ids builder mode (dedup=device).
-int64_t fm_abi_version() { return 3; }
+// 3 = raw_ids builder mode (dedup=device); 4 = keep_empty builder mode
+// (blank line -> zero-feature example; the predict path's line
+// alignment).
+int64_t fm_abi_version() { return 4; }
 
 // Returns 0 on success. Outputs:
 //   labels[n_examples], poses[n_examples+1], ids[nnz], vals[nnz]
@@ -485,6 +487,7 @@ struct BatchBuilder {
   bool hash_ids;
   bool field_aware = false;  // FFM `field:fid[:val]` tokens
   bool raw_ids = false;      // dedup=device: li holds raw ids, no dedup
+  bool keep_empty = false;   // blank line -> zero-feature example
   int64_t field_num = 0;
   int max_feats;
   int64_t max_uniq;  // 0 = unlimited; else batch closes BEFORE exceeding
@@ -554,7 +557,7 @@ extern "C" {
 
 void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
                 int field_aware, int64_t field_num, int raw_ids,
-                int max_feats, int64_t max_uniq) {
+                int keep_empty, int max_feats, int64_t max_uniq) {
   if (B <= 0 || L <= 0 || vocab <= 0) return nullptr;
   if (field_aware != 0 && field_num <= 0) return nullptr;
   // raw_ids skips dedup entirely; the fixed-U spill protocol needs the
@@ -567,6 +570,7 @@ void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
   bb->hash_ids = hash_ids != 0;
   bb->field_aware = field_aware != 0;
   bb->raw_ids = raw_ids != 0;
+  bb->keep_empty = keep_empty != 0;
   bb->field_num = field_num;
   bb->max_feats = (max_feats > 0 && max_feats < L) ? max_feats : int(L);
   // A single line adds <= max_feats uniques (+ the pad slot), so the cap
@@ -610,7 +614,13 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
     const char* q = p;
     bb->lineno++;
     while (q < line_end && is_ws(*q)) q++;
-    if (q == line_end) {  // blank line: skipped (training path)
+    if (q == line_end) {
+      if (bb->keep_empty) {
+        // Blank line -> zero-feature example, label 0 (predict owes one
+        // score per input line; the row buffers are already pad/zero).
+        bb->labels[size_t(bb->n_ex)] = 0.0f;
+        bb->n_ex++;
+      }
       p = line_end + 1;
       continue;
     }

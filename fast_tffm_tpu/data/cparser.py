@@ -52,7 +52,7 @@ def _build() -> None:
 
 # Must equal fm_abi_version() in _parser.cc. Bump both together whenever
 # an exported signature changes.
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 
 
 def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
@@ -149,6 +149,7 @@ def _load() -> ctypes.CDLL:
                                   ctypes.c_int64, ctypes.c_int,
                                   ctypes.c_int, ctypes.c_int64,  # field flag, count
                                   ctypes.c_int,                  # raw_ids
+                                  ctypes.c_int,                  # keep_empty
                                   ctypes.c_int, ctypes.c_int64]
         lib.fm_bb_free.argtypes = [ctypes.c_void_p]
         lib.fm_bb_feed.restype = ctypes.c_int
@@ -228,7 +229,7 @@ class BatchBuilder:
     def __init__(self, batch_size: int, max_cols: int,
                  vocabulary_size: int, hash_feature_id: bool = False,
                  field_aware: bool = False, field_num: int = 0,
-                 raw_ids: bool = False,
+                 raw_ids: bool = False, keep_empty: bool = False,
                  max_features_per_example: int = 0, max_uniq: int = 0):
         """``max_uniq`` > 0 caps the batch's unique-row count (incl. the
         pad slot): a line that would exceed it closes the batch early
@@ -238,7 +239,9 @@ class BatchBuilder:
         ``finish()`` return a fields array. ``raw_ids`` (dedup=device)
         skips the dedup pass: local_idx holds raw feature ids (pad cells
         = vocabulary_size) and finish() returns uniq=None; incompatible
-        with max_uniq."""
+        with max_uniq. ``keep_empty`` turns blank lines into
+        zero-feature examples (label 0) — the predict path's
+        one-score-per-input-line alignment."""
         self._lib = _load()
         self.B, self.L = batch_size, max_cols
         self.field_aware = field_aware
@@ -247,7 +250,7 @@ class BatchBuilder:
                                       vocabulary_size,
                                       int(hash_feature_id),
                                       int(field_aware), field_num,
-                                      int(raw_ids),
+                                      int(raw_ids), int(keep_empty),
                                       max_features_per_example,
                                       max_uniq)
         if not self._h:

@@ -392,8 +392,9 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
     permutation — the same mixing radius as the reference's bounded
     shuffle queue of ``queue_size`` lines (SURVEY §2 "Input pipeline"),
     expressed at batch granularity. Exact reservoir-per-line semantics
-    remain on the generic path (weight files / keep_empty / the Python
-    parser force it; FFM rides this fast path via field-aware tokens).
+    remain on the generic path (weight files or an unavailable C++
+    extension force it; FFM and keep_empty both ride this fast path —
+    field-aware tokens and blank-line examples are builder modes).
 
     With ``uniq_bucket`` (fixed_shape multi-process mode) the builder
     caps each batch's unique rows; a too-dense batch closes early with
@@ -527,12 +528,11 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
 
     # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
     # no feature needs per-line Python handling — including sharded
-    # multi-process input (byte ranges) and field-aware FFM tokens.
-    # Requires a hard per-example cap (the builder writes fixed-stride
-    # rows); max_features_per_example = 0 means "unlimited" and stays
-    # generic.
-    if (not keep_empty and not weight_files
-            and cfg.max_features_per_example > 0):
+    # multi-process input (byte ranges), field-aware FFM tokens, and
+    # keep_empty line alignment (predict). Requires a hard per-example
+    # cap (the builder writes fixed-stride rows);
+    # max_features_per_example = 0 means "unlimited" and stays generic.
+    if not weight_files and cfg.max_features_per_example > 0:
         try:
             from fast_tffm_tpu.data.cparser import BatchBuilder
             # A ladder value (power of two past the top), so batches with
@@ -543,7 +543,7 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                               hash_feature_id=cfg.hash_feature_id,
                               field_aware=cfg.model_type == "ffm",
                               field_num=cfg.field_num,
-                              raw_ids=raw_ids,
+                              raw_ids=raw_ids, keep_empty=keep_empty,
                               max_features_per_example=(
                                   cfg.max_features_per_example),
                               max_uniq=(uniq_bucket if fixed_shape else 0))
