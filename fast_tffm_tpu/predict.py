@@ -22,6 +22,7 @@ from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
 from fast_tffm_tpu.metrics import sigmoid
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
                                      make_batch_scorer, ships_raw_batches)
+from fast_tffm_tpu.utils.fetch import ChunkedFetcher
 from fast_tffm_tpu.utils.logging import get_logger
 
 
@@ -62,29 +63,17 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
     # keep_empty: blank input lines become zero-feature examples so the
     # score file stays line-aligned with the input (SURVEY §3.4).
-    # Scores stay on device and are fetched in chunks: a per-batch fetch
-    # syncs the dispatch pipeline each step (30x+ slower on a tunnelled
-    # chip), while holding a whole huge file would grow device memory
-    # linearly (train.FETCH_CHUNK_BATCHES bounds both).
-    from fast_tffm_tpu.train import FETCH_CHUNK_BATCHES
-    pending = []
+    # Chunked fetches (utils/fetch.py): per-batch syncs are ruinous over
+    # a tunnelled link, whole-file buffering is unbounded.
     out: List[np.ndarray] = []
-
-    def drain():
-        fetched = jax.device_get([s for s, _ in pending])
-        out.extend(np.asarray(s)[:n]
-                   for s, (_, n) in zip(fetched, pending))
-        pending.clear()
-
+    fetcher = ChunkedFetcher(lambda s, num_real: out.append(s[:num_real]))
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1, keep_empty=True,
                                          raw_ids=raw)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
-        pending.append((score_fn(table, args), batch.num_real))
-        if len(pending) >= FETCH_CHUNK_BATCHES:
-            drain()
-    drain()
+        fetcher.add(score_fn(table, args), batch.num_real)
+    fetcher.flush()
     return (np.concatenate(out) if out
             else np.zeros(0, dtype=np.float32))
 

@@ -28,15 +28,16 @@ from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
                                      make_train_step, ships_raw_batches)
+from fast_tffm_tpu.utils.fetch import FETCH_CHUNK_BATCHES, ChunkedFetcher
 from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.timing import StepTimer, trace_span
 
 
-# Scores held on device between bulk fetches in evaluate()/predict():
-# large enough to amortize the device-link round-trip, small enough to
-# bound live device arrays on huge sweeps (256 x [B] f32 ~ 8 MB at
-# B=8192).
-FETCH_CHUNK_BATCHES = 256
+# First-log-step probe threshold (train()): a materialized-scalar fetch
+# slower than this marks the device link as slow and defers loss log
+# lines to epoch boundaries. Module-level so tests can force either
+# mode.
+LIVE_FETCH_BUDGET_S = 0.005
 
 
 def evaluate(cfg: FmConfig, table: jax.Array, files,
@@ -52,35 +53,22 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     auc = StreamingAUC()
     n = 0
     n_batches = 0
-    # Scores stay on device and are fetched in chunks: a PER-BATCH fetch
-    # syncs the dispatch pipeline every step (ruinous over a tunnelled
-    # link — same pathology as train()'s loss logging), while holding
-    # the WHOLE sweep would grow device memory linearly with the
-    # validation set. FETCH_CHUNK batches amortize the round-trip and
-    # bound live arrays.
-    pending = []
-
-    def drain():
-        for scores, (_, labels, num_real) in zip(
-                jax.device_get([s for s, _, _ in pending]), pending):
-            auc.update(scores[:num_real], labels[:num_real])
-        pending.clear()
-
+    # Chunked fetches (utils/fetch.py): per-batch syncs are ruinous over
+    # a tunnelled link, whole-sweep buffering is unbounded.
+    fetcher = ChunkedFetcher(
+        lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]]))
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1, raw_ids=raw)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
-        pending.append((score_fn(table, args), batch.labels,
-                        batch.num_real))
+        fetcher.add(score_fn(table, args), (batch.labels, batch.num_real))
         n += batch.num_real
         n_batches += 1
-        if len(pending) >= FETCH_CHUNK_BATCHES:
-            drain()
         # Batch-count cap — the same per-input-shard unit the
         # distributed path uses, so AUC samples are comparable.
         if max_batches and n_batches >= max_batches:
             break
-    drain()
+    fetcher.flush()
     return auc.result(), n
 
 
@@ -305,7 +293,6 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     # if not, loss values are buffered ON DEVICE (scalars) and flushed
     # at epoch boundaries — a natural barrier — with correct per-step
     # attribution.
-    _LIVE_FETCH_BUDGET_S = 0.005
     log_mode = None          # decided at the first log step
     log_buffer: list = []    # deferred: (step, epoch, loss_arr, eps)
 
@@ -320,6 +307,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         import time as _time
         if log_mode == "deferred":
             log_buffer.append((s, ep, loss_arr, eps))
+            # Bound the buffer: log_steps=1 on a months-long epoch must
+            # not retain unbounded device scalars; one rare mid-epoch
+            # sync is the lesser evil.
+            if len(log_buffer) >= FETCH_CHUNK_BATCHES:
+                flush_log()
             return
         if log_mode is None:
             # Wait for the step itself OUTSIDE the timed window: the
@@ -330,7 +322,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             t0 = _time.perf_counter()
             val = float(loss_arr)
             cost = _time.perf_counter() - t0
-            log_mode = ("live" if cost < _LIVE_FETCH_BUDGET_S
+            log_mode = ("live" if cost < LIVE_FETCH_BUDGET_S
                         else "deferred")
             if log_mode == "deferred":
                 logger.info(

@@ -1,0 +1,48 @@
+"""Chunked device->host fetches for scoring sweeps.
+
+A PER-BATCH fetch syncs the dispatch pipeline every step — ruinous over
+a proxied device link (BASELINE.md "Device-link sync pathology") —
+while holding an unbounded sweep's scores grows device memory linearly.
+``ChunkedFetcher`` is the one implementation of the middle road, shared
+by train.evaluate and predict.predict_scores: accumulate device arrays,
+bulk-``device_get`` every ``chunk`` additions, deliver host arrays to a
+consumer in input order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+import numpy as np
+
+# Large enough to amortize the device-link round-trip, small enough to
+# bound live device arrays on huge sweeps (256 x [B] f32 ~ 8 MB at
+# B=8192).
+FETCH_CHUNK_BATCHES = 256
+
+
+class ChunkedFetcher:
+    """``add(device_array, meta)`` accumulates; every ``chunk`` adds (and
+    at the final explicit ``flush()``) the pending arrays are fetched in
+    ONE ``jax.device_get`` and ``consume(host_array, meta)`` runs for
+    each, in add order."""
+
+    def __init__(self, consume: Callable[[np.ndarray, Any], None],
+                 chunk: int = FETCH_CHUNK_BATCHES):
+        self._consume = consume
+        self._chunk = chunk
+        self._pending: List[Tuple[Any, Any]] = []
+
+    def add(self, arr, meta: Any = None) -> None:
+        self._pending.append((arr, meta))
+        if len(self._pending) >= self._chunk:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        fetched = jax.device_get([a for a, _ in self._pending])
+        for host, (_, meta) in zip(fetched, self._pending):
+            self._consume(np.asarray(host), meta)
+        self._pending.clear()

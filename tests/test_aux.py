@@ -111,3 +111,37 @@ def test_validation_max_batches_caps_eval(tmp_path, rng):
     log = (tmp_path / "fm.log").read_text()
     assert "validation AUC" in log
     assert "over 64 examples" in log
+
+
+def test_deferred_loss_logging_emits_every_line(tmp_path, monkeypatch):
+    """Forcing the slow-link path: every per-interval loss line must
+    still be emitted (at epoch boundaries) with correct step numbers and
+    real loss values — nothing dropped, nothing stale."""
+    import re
+    import numpy as np
+    from fast_tffm_tpu import train as train_mod
+    from fast_tffm_tpu.config import FmConfig
+
+    rng = np.random.default_rng(5)
+    lines = []
+    for _ in range(64):
+        ids = rng.choice(50, size=4, replace=False)
+        lines.append(" ".join(["1" if rng.random() < 0.5 else "0"]
+                              + [f"{i}:1" for i in ids]))
+    p = tmp_path / "d.txt"
+    p.write_text("\n".join(lines) + "\n")
+    log_file = tmp_path / "t.log"
+    cfg = FmConfig(vocabulary_size=50, factor_num=2, batch_size=16,
+                   train_files=(str(p),), epoch_num=2, log_steps=1,
+                   shuffle=False, learning_rate=0.1,
+                   log_file=str(log_file),
+                   model_file=str(tmp_path / "m" / "fm"))
+    monkeypatch.setattr(train_mod, "LIVE_FETCH_BUDGET_S", -1.0)
+    train_mod.train(cfg)
+    text = log_file.read_text()
+    assert "deferring loss log lines" in text
+    steps = [int(m) for m in re.findall(r"step (\d+) epoch \d+ loss", text)]
+    assert steps == list(range(1, 9)), steps  # 2 epochs x 4 batches
+    losses = [float(m) for m in
+              re.findall(r"loss (\d+\.\d+) examples/sec", text)]
+    assert len(set(losses)) > 1  # real per-step values, not one repeated

@@ -39,8 +39,14 @@ def test_spill_counted_and_lossless(tmp_path, generic):
     cfg = FmConfig(vocabulary_size=4096, batch_size=16, uniq_bucket=64,
                    max_features_per_example=16, bucket_ladder=(16,),
                    shuffle=False)
-    # keep_empty forces the generic (Python make_device_batch) path.
-    batches, stats = _run(cfg, path, keep_empty=generic)
+    # weight_files force the generic (Python make_device_batch) path —
+    # keep_empty no longer does (it is a C++ builder mode since ABI 4).
+    kw = {}
+    if generic:
+        wpath = tmp_path / "w.txt"
+        wpath.write_text("1.0\n" * 64)
+        kw["weight_files"] = (str(wpath),)
+    batches, stats = _run(cfg, path, **kw)
     assert stats.spilled_batches > 0
     assert stats.batches == len(batches)
     assert stats.fill_fraction < 1.0
