@@ -28,7 +28,7 @@ from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
                                      make_train_step, ships_raw_batches)
-from fast_tffm_tpu.utils.fetch import FETCH_CHUNK_BATCHES, ChunkedFetcher
+from fast_tffm_tpu.utils.fetch import ChunkedFetcher
 from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.timing import StepTimer, trace_span
 
@@ -38,6 +38,12 @@ from fast_tffm_tpu.utils.timing import StepTimer, trace_span
 # lines to epoch boundaries. Module-level so tests can force either
 # mode.
 LIVE_FETCH_BUDGET_S = 0.005
+
+# Deferred-mode loss-log buffer cap: scalar device arrays retained
+# between flushes. Deliberately its own constant — FETCH_CHUNK_BATCHES
+# is tuned for bulk [B]-score memory, and retuning that must not change
+# how often a slow link pays a mid-epoch log sync.
+LOG_BUFFER_MAX = 1024
 
 
 def evaluate(cfg: FmConfig, table: jax.Array, files,
@@ -310,7 +316,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             # Bound the buffer: log_steps=1 on a months-long epoch must
             # not retain unbounded device scalars; one rare mid-epoch
             # sync is the lesser evil.
-            if len(log_buffer) >= FETCH_CHUNK_BATCHES:
+            if len(log_buffer) >= LOG_BUFFER_MAX:
                 flush_log()
             return
         if log_mode is None:
