@@ -65,6 +65,10 @@ class FmConfig:
     init_value_range: float = 0.01
     loss_type: str = "logistic"     # "logistic" | "mse"
     queue_size: int = 10000
+    # Reference knob (reader/shuffle thread count). Parsing here is one
+    # GIL-releasing C++ pass, so the honest analogue is input-pipeline
+    # LOOKAHEAD: this many batches are prepared ahead of the device
+    # (prefetch_depth clamps it to [2, 8]).
     shuffle_threads: int = 1
     shuffle: bool = True
     seed: int = 0
@@ -186,6 +190,12 @@ class FmConfig:
         return k + 1
 
     @property
+    def prefetch_depth(self) -> int:
+        """Input-pipeline lookahead in batches (data/pipeline.prefetch),
+        mapped from the reference's ``shuffle_threads`` knob."""
+        return max(2, min(self.shuffle_threads, 8))
+
+    @property
     def pad_id(self) -> int:
         """Sentinel row index used for padding; one extra dead row is
         appended to the table so padded positions gather zeros and their
@@ -297,9 +307,4 @@ def load_config(path: str) -> FmConfig:
             "for compatibility but has no effect: the reference used it to "
             "partition the table across parameter servers; here the device "
             "mesh decides row sharding (parallel/sharded.py)")
-    if cfg.shuffle_threads > 1:
-        warnings.warn(
-            f"shuffle_threads = {cfg.shuffle_threads} is accepted for "
-            "compatibility but has no effect: shuffling is a deterministic "
-            "bounded reservoir on the input iterator, not a thread pool")
     return cfg

@@ -69,7 +69,8 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     fetcher = ChunkedFetcher(lambda s, num_real: out.append(s[:num_real]))
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1, keep_empty=True,
-                                         raw_ids=raw)):
+                                         raw_ids=raw),
+                          depth=cfg.prefetch_depth):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         fetcher.add(score_fn(table, args), batch.num_real)

@@ -64,7 +64,8 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     fetcher = ChunkedFetcher(
         lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]]))
     for batch in prefetch(batch_iterator(cfg, files, training=False,
-                                         epochs=1, raw_ids=raw)):
+                                         epochs=1, raw_ids=raw),
+                          depth=cfg.prefetch_depth):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         fetcher.add(score_fn(table, args), (batch.labels, batch.num_real))
@@ -362,7 +363,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 weight_files=cfg.weight_files, shard_index=shard_index,
                 num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
                 fixed_shape=multi_process, uniq_bucket=uniq_bucket,
-                stats=epoch_stats, raw_ids=raw_mode))
+                stats=epoch_stats, raw_ids=raw_mode),
+                depth=cfg.prefetch_depth)
             while True:
                 batch = next(it, None)
                 if multi_process:

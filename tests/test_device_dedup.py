@@ -179,3 +179,50 @@ score_path = {tmp_path}/score
     assert len(scores) == 64
     assert np.isfinite(scores).all() and (0 <= scores).all() \
         and (scores <= 1).all()
+
+
+def test_checkpoint_crosses_dedup_modes(tmp_path):
+    """A checkpoint is mode-free state: training saved under dedup=host
+    must resume under dedup=device with the identical continued
+    trajectory — the unique-pass location cannot leak into persistence.
+    Runs in a 1-CPU-device subprocess (dedup=device is single-device;
+    the in-process env pins 8)."""
+    path = _write(tmp_path, n=64, seed=21)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    code = f"""
+import shutil
+import numpy as np
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.train import train
+
+def cfg_for(dedup, epochs, model):
+    return FmConfig(vocabulary_size=300, factor_num=4, batch_size=16,
+                    train_files=(r'{path}',), shuffle=False,
+                    bucket_ladder=(4, 8, 16),
+                    max_features_per_example=16, learning_rate=0.1,
+                    epoch_num=epochs, dedup=dedup,
+                    model_file=r'{tmp_path}' + '/' + model + '/fm')
+
+import logging
+records = []
+class Grab(logging.Handler):
+    def emit(self, r):
+        records.append(r.getMessage())
+logging.getLogger('fast_tffm_tpu').addHandler(Grab())
+
+train(cfg_for('host', 1, 'a'))
+shutil.copytree(r'{tmp_path}/a', r'{tmp_path}/b')
+t_host = np.asarray(train(cfg_for('host', 3, 'a')))
+t_dev = np.asarray(train(cfg_for('device', 3, 'b')))
+# Guard against vacuous success: both resumed runs must actually have
+# RESTORED (a fresh-start pair would also match, trivially).
+restores = [m for m in records if m.startswith('restored checkpoint')]
+assert len(restores) == 2, records
+np.testing.assert_allclose(t_dev, t_host, rtol=1e-6, atol=1e-7)
+print('cross-mode resume ok')
+"""
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "cross-mode resume ok" in out.stdout

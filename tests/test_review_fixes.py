@@ -107,8 +107,13 @@ def test_ignored_reference_knobs_warn(tmp_path):
     p.write_text("[General]\nvocabulary_block_num = 100\n"
                  "[Train]\nshuffle_threads = 4\n")
     with pytest.warns(UserWarning, match="vocabulary_block_num"):
-        with pytest.warns(UserWarning, match="shuffle_threads"):
-            load_config(str(p))
+        cfg = load_config(str(p))
+    # shuffle_threads is no longer a warned no-op: it maps to the input
+    # pipeline's prefetch lookahead (clamped to [2, 8]).
+    assert cfg.prefetch_depth == 4
+    import dataclasses
+    assert dataclasses.replace(cfg, shuffle_threads=99).prefetch_depth == 8
+    assert dataclasses.replace(cfg, shuffle_threads=0).prefetch_depth == 2
 
 
 def test_checkpoint_shape_mismatch_is_actionable(tmp_path):
