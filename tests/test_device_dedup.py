@@ -209,7 +209,9 @@ records = []
 class Grab(logging.Handler):
     def emit(self, r):
         records.append(r.getMessage())
-logging.getLogger('fast_tffm_tpu').addHandler(Grab())
+_lg = logging.getLogger('fast_tffm_tpu')
+_lg.addHandler(Grab())
+_lg.setLevel(logging.INFO)  # get_logger skips setup once handlers exist
 
 train(cfg_for('host', 1, 'a'))
 shutil.copytree(r'{tmp_path}/a', r'{tmp_path}/b')
