@@ -198,3 +198,43 @@ def test_streaming_auc_converges_to_exact(data):
         i = j
     want = exact_auc(sigmoid(scores), labels)  # sigmoid is monotonic
     assert auc.result() == pytest.approx(want, abs=2e-3)
+
+
+# --- dedup mode equivalence -------------------------------------------------
+
+
+@settings(max_examples=20, deadline=None, derandomize=True)
+@given(data=st.data())
+def test_device_dedup_equals_host_property(tmp_path_factory, data):
+    """Random batches: the on-device unique pass (dedup=device, raw-ids
+    batches) and the host-side pass produce identical losses, tables,
+    and accumulators. Reuses test_device_dedup's harness — one
+    equivalence loop, example- and property-tested."""
+    import dataclasses
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_device_dedup import _cfg, _train_all
+    from fast_tffm_tpu.models.fm import ModelSpec
+
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**32 - 1)))
+    vocab = 40
+    n_lines = data.draw(st.integers(1, 24))
+    lines = []
+    for _ in range(n_lines):
+        nnz = int(rng.integers(1, 8))
+        ids = rng.choice(vocab, size=nnz, replace=False)
+        lines.append(" ".join([str(int(rng.integers(0, 2)))]
+                              + [f"{i}:{rng.random():.3f}" for i in ids]))
+    p = tmp_path_factory.mktemp("dd") / "d.txt"
+    p.write_text("\n".join(lines) + "\n")
+    # Fixed shapes (single-rung ladder, small B) so one compiled step
+    # serves every drawn example.
+    cfg = _cfg(str(p), vocabulary_size=vocab, factor_num=2, batch_size=8,
+               bucket_ladder=(8,), max_features_per_example=8)
+    host = _train_all(cfg, ModelSpec.from_config(cfg), raw=False)
+    dev = _train_all(cfg, dataclasses.replace(ModelSpec.from_config(cfg),
+                                              dedup="device"), raw=True)
+    np.testing.assert_allclose(dev[2], host[2], rtol=1e-6)
+    np.testing.assert_allclose(dev[0], host[0], rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(dev[1], host[1], rtol=1e-6, atol=1e-7)
