@@ -111,15 +111,20 @@ class CheckpointState:
             # Orbax surfaces config-mismatch as a shape ValueError (whose
             # advice — enable truncation — is wrong here) or, for a
             # checkpoint predating a template key such as 'vocab', as a
-            # tree-structure error. Both mean the same thing to a user:
-            # the checkpoint was written under a different config or an
-            # older storage layout.
+            # tree-structure error. The same exception classes can also
+            # mean a corrupt/partial step directory (killed writer), so
+            # the advice names both causes rather than steering a user
+            # toward discarding a recoverable checkpoint.
             raise ValueError(
-                f"checkpoint at {self.directory} step {s} does not match "
-                "this config's layout: it was written under a different "
-                "config (vocabulary_size / factor_num / model_type) or an "
-                "older storage layout. Retrain, or point model_file at "
-                f"the matching checkpoint. Underlying error: {e}") from e
+                f"checkpoint at {self.directory} step {s} could not be "
+                "restored against this config's layout. Most likely the "
+                "checkpoint was written under a different config "
+                "(vocabulary_size / factor_num / model_type) or an older "
+                "storage layout — fix the config or point model_file at "
+                "the matching checkpoint. If the config is right, this "
+                "step directory may be corrupt/partially written (killed "
+                "save): try an earlier step or delete the bad step dir. "
+                f"Underlying error: {e}") from e
 
     def close(self) -> None:
         self._mngr.close()

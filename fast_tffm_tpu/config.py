@@ -229,6 +229,7 @@ _GENERAL_KEYS = {
     "order": int,
     "field_num": int,
     "lookup": str,
+    "dedup": str,
 }
 _TRAIN_KEYS = {
     "train_files": _split_files,
@@ -253,6 +254,7 @@ _TRAIN_KEYS = {
     "bucket_ladder": _split_ints,
     "uniq_bucket": int,
     "kernel": str,
+    "dedup": str,  # accepted in [General] too (model-level knob)
     "profile_dir": str,
     "profile_start_step": int,
     "profile_num_steps": int,

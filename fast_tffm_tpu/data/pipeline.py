@@ -332,6 +332,23 @@ def _iter_owned_chunks(path: str, start: int, end: int,
             pos += len(b)
 
 
+def _iter_range_lines(path: str, start: int, end: int) -> Iterator[str]:
+    """Decoded lines owned by byte range [start, end) of ``path``
+    (ownership rules of _iter_owned_chunks). Splits on newlines BEFORE
+    decoding so a multibyte UTF-8 character straddling a chunk boundary
+    survives intact — the one implementation of the tail-carry split
+    shared by _iter_lines and probe_uniq_bucket (the C++ fast path
+    consumes raw bytes and never forms lines in Python)."""
+    tail = b""
+    for chunk in _iter_owned_chunks(path, start, end):
+        parts = (tail + chunk if tail else chunk).split(b"\n")
+        tail = parts.pop()
+        for raw in parts:
+            yield raw.decode("utf-8")
+    if tail:  # final owned line missing its newline
+        yield tail.decode("utf-8")
+
+
 def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                 shard_index: int, num_shards: int,
                 keep_empty: bool = False) -> Iterator[Tuple[str, float]]:
@@ -360,17 +377,7 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
         return
     for path in files:
         start, end = shard_byte_range(path, shard_index, num_shards)
-        tail = b""
-        for chunk in _iter_owned_chunks(path, start, end):
-            data = tail + chunk if tail else chunk
-            parts = data.split(b"\n")
-            tail = parts.pop()
-            for raw in parts:
-                line = raw.decode("utf-8")
-                if line.strip() or keep_empty:
-                    yield line, 1.0
-        if tail:
-            line = tail.decode("utf-8")
+        for line in _iter_range_lines(path, start, end):
             if line.strip() or keep_empty:
                 yield line, 1.0
 
@@ -663,18 +670,11 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
     got_lines = False
     for start in sorted({0, size // 3, 2 * size // 3}):
         lines: List[str] = []
-        buf = b""
-        # Split on newlines BEFORE decoding: a multibyte UTF-8 character
-        # straddling a chunk boundary must reach the parser intact (the
-        # hash of a mangled token would drift from what real batches see).
-        for chunk in _iter_owned_chunks(files[0], start, size):
-            parts = (buf + chunk).split(b"\n")
-            buf = parts.pop()
-            lines.extend(l.decode("utf-8") for l in parts if l.strip())
+        for line in _iter_range_lines(files[0], start, size):
+            if line.strip():
+                lines.append(line)
             if len(lines) >= B:
                 break
-        if buf.strip() and len(lines) < B:
-            lines.append(buf.decode("utf-8"))
         if not lines:
             continue
         got_lines = True

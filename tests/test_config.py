@@ -91,3 +91,37 @@ def test_extension_keys(tmp_path):
     cfg = load_config(path)
     assert cfg.model_type == "ffm"
     assert cfg.row_dim == 7
+
+
+def test_every_documented_extension_knob_is_reachable(tmp_path):
+    """Every knob sample.cfg's header documents must parse from INI —
+    a documented-but-unregistered key (dedup was one) strands the
+    feature outside the CLI."""
+    path = write_cfg(tmp_path, """
+        [General]
+        vocabulary_size = 100
+        model_type = ffm
+        field_num = 4
+        order = 2
+        lookup = device
+        dedup = host
+
+        [Train]
+        train_files = data/a.txt
+        kernel = xla
+        dedup = device
+        max_features_per_example = 32
+        bucket_ladder = 8,32
+        uniq_bucket = 128
+        validation_max_batches = 5
+        shuffle_threads = 3
+    """)
+    cfg = load_config(path)
+    assert cfg.dedup == "device"        # [Train] wins over [General]
+    assert cfg.kernel == "xla"
+    assert cfg.model_type == "ffm" and cfg.field_num == 4
+    assert cfg.lookup == "device"
+    assert cfg.bucket_ladder == (8, 32)
+    assert cfg.uniq_bucket == 128
+    assert cfg.validation_max_batches == 5
+    assert cfg.prefetch_depth == 3
