@@ -232,6 +232,65 @@ worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
 
 
 @pytest.mark.slow
+def test_two_worker_dist_predict_ffm(tmp_path):
+    """FFM through multi-process predict: field-aware fixed-shape input
+    under byte ranges, fields through global_batch into the sharded
+    scorer, chief-merged score file equal to single-process."""
+    rng = np.random.default_rng(9)
+    lines = []
+    for _ in range(90):
+        nnz = rng.integers(2, 8)
+        ids = rng.choice(128, size=nnz, replace=False)
+        toks = [f"{int(rng.integers(0, 4))}:{i}:{rng.random():.3f}"
+                for i in ids]
+        lines.append(" ".join(["1" if rng.random() < 0.5 else "0"] + toks))
+    data = tmp_path / "train.txt"
+    data.write_text("\n".join(lines) + "\n")
+
+    model = tmp_path / "model" / "ffm"
+    coord = _free_port()
+    cfg = tmp_path / "dist.cfg"
+    cfg.write_text(f"""
+[General]
+vocabulary_size = 128
+factor_num = 2
+model_type = ffm
+field_num = 4
+model_file = {model}
+
+[Train]
+train_files = {data}
+epoch_num = 1
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+max_features_per_example = 8
+bucket_ladder = 8
+
+[Predict]
+predict_files = {data}
+score_path = {tmp_path}/score
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+    _launch_mode(cfg, "train")
+    outs = _launch_mode(cfg, "predict")
+    assert sum("merged 2 parts" in o for o in outs) == 1
+    scores_mp = np.loadtxt(tmp_path / "score" / "train.txt.score")
+    assert len(scores_mp) == 90
+
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.predict import predict
+    import dataclasses
+    sp_cfg = dataclasses.replace(load_config(str(cfg)),
+                                 score_path=str(tmp_path / "score_sp"))
+    predict(sp_cfg)
+    scores_sp = np.loadtxt(tmp_path / "score_sp" / "train.txt.score")
+    np.testing.assert_allclose(scores_mp, scores_sp, atol=2e-6)
+
+
+@pytest.mark.slow
 def test_two_process_adagrad_convergence_parity(tmp_path):
     """The documented multi-process Adagrad divergence (an id hot on
     several processes accumulates sum-of-per-process g^2 instead of
