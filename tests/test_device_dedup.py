@@ -62,7 +62,8 @@ def test_device_dedup_matches_host(tmp_path):
     and accumulator (the unique pass location must be invisible)."""
     path = _write(tmp_path)
     cfg = _cfg(path)
-    host = _train_all(cfg, ModelSpec.from_config(cfg), raw=False)
+    host = _train_all(cfg, dataclasses.replace(
+        ModelSpec.from_config(cfg), dedup="host"), raw=False)
     dev_spec = dataclasses.replace(ModelSpec.from_config(cfg),
                                    dedup="device")
     dev = _train_all(cfg, dev_spec, raw=True)
@@ -75,7 +76,8 @@ def test_device_dedup_ffm_matches_host(tmp_path):
     """FFM raw-ids mode: fields ride along unchanged."""
     path = _write(tmp_path, ffm=True)
     cfg = _cfg(path, model_type="ffm", field_num=4)
-    host = _train_all(cfg, ModelSpec.from_config(cfg), raw=False)
+    host = _train_all(cfg, dataclasses.replace(
+        ModelSpec.from_config(cfg), dedup="host"), raw=False)
     dev_spec = dataclasses.replace(ModelSpec.from_config(cfg),
                                    dedup="device")
     dev = _train_all(cfg, dev_spec, raw=True)
@@ -87,7 +89,7 @@ def test_device_dedup_score_parity(tmp_path):
     path = _write(tmp_path, seed=9)
     cfg = _cfg(path)
     table = init_table(cfg, 3)
-    spec_h = ModelSpec.from_config(cfg)
+    spec_h = dataclasses.replace(ModelSpec.from_config(cfg), dedup="host")
     spec_d = dataclasses.replace(spec_h, dedup="device")
     sh, sd = [], []
     for raw, spec, out in ((False, spec_h, sh), (True, spec_d, sd)):

@@ -232,7 +232,8 @@ def test_device_dedup_equals_host_property(tmp_path_factory, data):
     # serves every drawn example.
     cfg = _cfg(str(p), vocabulary_size=vocab, factor_num=2, batch_size=8,
                bucket_ladder=(8,), max_features_per_example=8)
-    host = _train_all(cfg, ModelSpec.from_config(cfg), raw=False)
+    host = _train_all(cfg, dataclasses.replace(
+        ModelSpec.from_config(cfg), dedup="host"), raw=False)
     dev = _train_all(cfg, dataclasses.replace(ModelSpec.from_config(cfg),
                                               dedup="device"), raw=True)
     np.testing.assert_allclose(dev[2], host[2], rtol=1e-6)
