@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import configparser
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 
 def _split_ints(raw: str) -> Tuple[int, ...]:

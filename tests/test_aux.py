@@ -68,7 +68,6 @@ def test_sigterm_saves_checkpoint(tmp_path):
 
 def test_profile_trace_dump(tmp_path):
     """profile_dir writes a TensorBoard/Perfetto trace of a step window."""
-    import jax
 
     from fast_tffm_tpu.config import load_config
     from fast_tffm_tpu.train import train

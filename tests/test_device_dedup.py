@@ -8,9 +8,6 @@ import subprocess
 import sys
 
 import numpy as np
-
-import jax
-
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.pipeline import batch_iterator
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,

@@ -8,7 +8,6 @@ from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.parser import ParsedBlock
 from fast_tffm_tpu.data.pipeline import make_device_batch
 from fast_tffm_tpu.models import oracle
-from fast_tffm_tpu.models.fm import ModelSpec
 from fast_tffm_tpu.ops.interaction import (batch_reg, ffm_batch_scores,
                                            fm_batch_scores, gather_rows)
 
