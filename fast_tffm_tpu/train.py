@@ -300,7 +300,31 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     # if not, loss values are buffered ON DEVICE (scalars) and flushed
     # at epoch boundaries — a natural barrier — with correct per-step
     # attribution.
-    log_mode = None          # decided at the first log step
+    # Probe the link BEFORE the hot loop, with an empty dispatch queue:
+    # a mid-stream probe on a slow link costs seconds (it drains the
+    # queue through the slow path — measured ~10 s at step 61 of a
+    # criteo-shaped run) where this costs one clean round-trip.
+    def _probe_link() -> str:
+        import time as _time
+        if cfg.log_steps <= 0:
+            return "deferred"  # mode never consulted without log lines
+        probe = jax.device_put(np.float32(0.0))
+        jax.block_until_ready(probe)
+        float(probe)  # throwaway: lazy transfer-path init stays untimed
+        cost = float("inf")
+        for _ in range(3):  # min of 3: jitter must not misclassify
+            t0 = _time.perf_counter()
+            float(probe)
+            cost = min(cost, _time.perf_counter() - t0)
+        if cost < LIVE_FETCH_BUDGET_S:
+            return "live"
+        logger.info(
+            "scalar fetch costs %.0f ms on this device link; deferring "
+            "loss log lines to epoch boundaries to keep the dispatch "
+            "pipeline hot", cost * 1e3)
+        return "deferred"
+
+    log_mode = _probe_link()
     log_buffer: list = []    # deferred: (step, epoch, loss_arr, eps)
 
     def log_line(s, ep, val, eps):
@@ -310,8 +334,6 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     s, ep, val, eps)
 
     def log_tick(s, ep, loss_arr, eps):
-        nonlocal log_mode
-        import time as _time
         if log_mode == "deferred":
             log_buffer.append((s, ep, loss_arr, eps))
             # Bound the buffer: log_steps=1 on a months-long epoch must
@@ -320,25 +342,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             if len(log_buffer) >= LOG_BUFFER_MAX:
                 flush_log()
             return
-        if log_mode is None:
-            # Wait for the step itself OUTSIDE the timed window: the
-            # probe must measure the link fetch, not pipeline drain —
-            # timing the drain would misclassify normal hardware (step
-            # time >> fetch time) as a slow link.
-            jax.block_until_ready(loss_arr)
-            t0 = _time.perf_counter()
-            val = float(loss_arr)
-            cost = _time.perf_counter() - t0
-            log_mode = ("live" if cost < LIVE_FETCH_BUDGET_S
-                        else "deferred")
-            if log_mode == "deferred":
-                logger.info(
-                    "loss fetch cost %.0f ms on this device link; "
-                    "deferring loss log lines to epoch boundaries to "
-                    "keep the dispatch pipeline hot", cost * 1e3)
-        else:
-            val = float(loss_arr)
-        log_line(s, ep, val, eps)
+        log_line(s, ep, float(loss_arr), eps)
 
     def flush_log():
         if not log_buffer:
