@@ -18,11 +18,27 @@ Line formats (SURVEY Appendix A data format):
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from fast_tffm_tpu.data.hashing import hash_feature
+
+# The libsvm separator set, pinned to the C++ parser's byte-level
+# ``is_ws`` (_parser.cc): space, tab, CR, VT, FF (+ newline, which never
+# appears inside a line). Python's bare str.split()/str.strip() would
+# additionally treat ASCII control separators (\x1c-\x1f) and Unicode
+# whitespace (\x85, \xa0, ...) as separators — inputs the C++ path
+# parses as token bytes — so the two paths would disagree on the same
+# line. Both sides use THIS set; tests/test_properties.py pins parity.
+WHITESPACE = " \t\r\n\v\f"
+_TOKEN_SPLIT = re.compile("[" + WHITESPACE + "]+")
+
+
+def split_tokens(line: str) -> List[str]:
+    """``line.split()`` restricted to the libsvm separator set."""
+    return [t for t in _TOKEN_SPLIT.split(line) if t]
 
 
 @dataclasses.dataclass
@@ -88,7 +104,7 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
     flds: List[int] = []
 
     for lineno, line in enumerate(lines):
-        toks = line.split()
+        toks = split_tokens(line)
         if not toks:
             if keep_empty:
                 labels.append(0.0)

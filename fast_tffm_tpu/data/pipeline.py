@@ -31,7 +31,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from fast_tffm_tpu.config import FmConfig
-from fast_tffm_tpu.data.parser import ParsedBlock
+from fast_tffm_tpu.data.parser import WHITESPACE, ParsedBlock
 
 
 class UniqOverflow(ValueError):
@@ -369,7 +369,7 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
             with open(path) as fh, open(wpath) as wfh:
                 for line in fh:
                     wline = wfh.readline()
-                    if not line.strip() and not keep_empty:
+                    if not line.strip(WHITESPACE) and not keep_empty:
                         continue
                     if idx % num_shards == shard_index:
                         yield line, float(wline) if wline.strip() else 1.0
@@ -378,7 +378,10 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
     for path in files:
         start, end = shard_byte_range(path, shard_index, num_shards)
         for line in _iter_range_lines(path, start, end):
-            if line.strip() or keep_empty:
+            # strip() pinned to the libsvm separator set: a line holding
+            # only \x1c would read as blank here (skipped) but as a
+            # parse-error line on the C++ fast path otherwise.
+            if line.strip(WHITESPACE) or keep_empty:
                 yield line, 1.0
 
 
@@ -646,13 +649,15 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
     measuring the data instead of assuming the worst case (the ladder
     top is next_pow2(B*L) — ~50x a realistic Criteo batch's uniques).
 
-    Parses one batch each from the head, middle, and tail of the FIRST
-    file — every process reads the same bytes, so all agree without a
-    collective — and returns the next power of two >= 2x the max
-    measured unique count (>= 64, > the per-example cap, <= the ladder
-    top). Densities the probe missed are absorbed by the spill protocol,
-    costing throughput, never correctness — and counted by SpillStats so
-    a mis-probe is visible in the epoch log.
+    Parses one batch each from the head, middle, and tail of the FIRST,
+    LAST, and LARGEST files (day-partitioned datasets whose later files
+    are denser would defeat a first-file-only probe) — every process
+    reads the same bytes, so all agree without a collective — and
+    returns the next power of two >= 2x the max measured unique count
+    (>= 64, > the per-example cap, <= the ladder top). Densities the
+    probe still missed are absorbed by the spill protocol, costing
+    throughput, never correctness — counted by SpillStats, warned at
+    epoch end, and recovered by train()'s epoch-boundary bucket raise.
     """
     B = batch_size or cfg.batch_size
     files = expand_files(files)
@@ -660,32 +665,37 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
     from fast_tffm_tpu.data.cparser import parse_lines_fast
     parse = parse_lines_fast
 
-    # One batch from the head, middle, and tail of the first file (byte
-    # offsets, first-newline aligned like shard_byte_range): sorted or
-    # sparse-first data whose head underestimates density would
-    # otherwise spill every denser batch downstream. Still deterministic
-    # and collective-free — every process reads the same bytes.
-    size = os.path.getsize(files[0])
+    cand = sorted({files[0], files[-1],
+                   max(files, key=os.path.getsize)})
     u_max = 0
     got_lines = False
-    for start in sorted({0, size // 3, 2 * size // 3}):
-        lines: List[str] = []
-        for line in _iter_range_lines(files[0], start, size):
-            if line.strip():
-                lines.append(line)
-            if len(lines) >= B:
-                break
-        if not lines:
-            continue
-        got_lines = True
-        block = _parse_block(lines[:B], cfg, parse)
-        u_max = max(u_max, len(np.unique(block.ids)))
+    for path in cand:
+        size = os.path.getsize(path)
+        for start in sorted({0, size // 3, 2 * size // 3}):
+            lines: List[str] = []
+            for line in _iter_range_lines(path, start, size):
+                if line.strip(WHITESPACE):
+                    lines.append(line)
+                if len(lines) >= B:
+                    break
+            if not lines:
+                continue
+            got_lines = True
+            block = _parse_block(lines[:B], cfg, parse)
+            u_max = max(u_max, len(np.unique(block.ids)))
     if not got_lines:
         return min(1 << 10, top)
     b = 64
     while b < 2 * (u_max + 2) or b <= cfg.max_features_per_example:
         b *= 2
     return min(b, top)
+
+
+def uniq_bucket_top(cfg: FmConfig, batch_size: Optional[int] = None) -> int:
+    """The worst-case unique bucket (ladder top) — the ceiling for
+    train()'s epoch-boundary adaptive raise."""
+    return _uniq_ladder(batch_size or cfg.batch_size,
+                        effective_L_cap(cfg))[-1]
 
 
 def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None,

@@ -5,11 +5,12 @@ protocol's no-loss/no-duplication guarantee under random unique budgets,
 and the streaming binned AUC against the exact rank statistic.
 
 Parser-parity scope note: the contract is byte-oriented libsvm data
-(printable ASCII tokens, space/tab separators) — the generator draws
-from that alphabet. Python's str.split() additionally treats exotic
-Unicode whitespace as separators, which the byte-level C++ parser
-deliberately does not; that input class is outside the data format
-(SURVEY Appendix A) and excluded here.
+with the separator set pinned to parser.WHITESPACE (space/tab/CR/VT/FF
+— the C++ is_ws set). The Python parser tokenizes with that exact set
+(not bare str.split(), which would additionally treat ASCII control
+separators \\x1c-\\x1f and Unicode whitespace like \\x85 as
+separators), so both paths agree on every byte; the token alphabet
+below includes the control separators to pin that.
 """
 
 import string
@@ -52,7 +53,11 @@ _TOKENS = st.one_of(
     st.sampled_from([":", "::", "a:", ":1", "a::1", "1:2:3:4", "-",
                      "nan", "inf", "+", "0x10", "1_0", "1:0x10",
                      "1:1e400", "1:-1e400", "1:1e-400", "1:Infinity",
-                     "1:nan(box)", "1:INF", "1e400", "०:1", "1:१"]),
+                     "1:nan(box)", "1:INF", "1e400", "०:1", "1:१",
+                     # ASCII control separators are TOKEN bytes for both
+                     # parsers (parser.WHITESPACE), never separators:
+                     "1\x1c", "1:1\x1c2", "\x1d", "1:\x1e5", "\x1f:1",
+                     "1:1\x85"]),
 )
 
 _LINES = st.lists(
