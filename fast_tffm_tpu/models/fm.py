@@ -56,6 +56,18 @@ class ModelSpec:
     @classmethod
     def from_config(cls, cfg: FmConfig) -> "ModelSpec":
         kernel = cfg.kernel
+        if kernel == "pallas" and (cfg.model_type == "ffm"
+                                   or cfg.order != 2):
+            # The fused Pallas kernel covers 2nd-order FM only; an
+            # explicit `kernel = pallas` on FFM/order>2 would otherwise
+            # silently run XLA (the same silent-config-betrayal pattern
+            # as the old mesh coercion). Warn and make the spec honest.
+            import warnings
+            warnings.warn(
+                f"kernel = pallas is only implemented for 2nd-order FM; "
+                f"model_type={cfg.model_type!r} order={cfg.order} runs "
+                "the XLA scorer instead")
+            kernel = "xla"
         if kernel == "auto":
             # Pallas wherever the fused kernel applies (2nd-order FM) and
             # the backend can run it natively; interpret mode off-TPU is a

@@ -53,6 +53,72 @@ def test_reference_schema_roundtrip(tmp_path):
     assert cfg.pad_id == 80000
 
 
+def test_appendix_a_cfg_loads_verbatim(tmp_path):
+    """SURVEY Appendix A's reconstructed sample.cfg — every key,
+    including the [L]-tier ones (weight_files, validation_files,
+    save_summaries_steps) — loads without error; no-op reference knobs
+    warn instead of raising (VERDICT r3 missing #3)."""
+    path = write_cfg(tmp_path, """
+        [General]
+        vocabulary_size = 80000000
+        vocabulary_block_num = 100
+        hash_feature_id = True
+        factor_num = 8
+        model_file = ./model/fm_model
+        log_file = ./log/fm.log
+
+        [Train]
+        train_files = data/train_*.txt
+        weight_files =
+        validation_files =
+        epoch_num = 10
+        batch_size = 10000
+        learning_rate = 0.01
+        factor_lambda = 1e-5
+        bias_lambda = 1e-5
+        init_value_range = 0.01
+        loss_type = logistic
+        queue_size = 10000
+        shuffle_threads = 4
+        save_summaries_steps = 100
+
+        [Predict]
+        predict_files = data/test_*.txt
+        score_path = ./score/
+
+        [Cluster]
+        ps_hosts = host1:2220,host2:2220
+        worker_hosts = host3:2230,host4:2230
+    """)
+    with pytest.warns(UserWarning) as rec:
+        cfg = load_config(path)
+    msgs = [str(w.message) for w in rec]
+    assert any("vocabulary_block_num" in m for m in msgs)
+    assert any("save_summaries_steps" in m for m in msgs)
+    assert cfg.vocabulary_size == 80000000
+    assert cfg.save_summaries_steps == 100
+    assert cfg.weight_files == () and cfg.validation_files == ()
+    assert cfg.ps_hosts == ("host1:2220", "host2:2220")
+
+
+def test_kernel_pallas_fallback_warns():
+    """Explicit kernel=pallas on FFM / order>2 warns and resolves to the
+    XLA scorer instead of silently betraying the config (VERDICT r3
+    weak #2)."""
+    from fast_tffm_tpu.models.fm import ModelSpec
+    for kwargs in (dict(model_type="ffm", field_num=3),
+                   dict(order=3)):
+        cfg = FmConfig(kernel="pallas", **kwargs)
+        with pytest.warns(UserWarning, match="2nd-order FM"):
+            spec = ModelSpec.from_config(cfg)
+        assert spec.kernel == "xla"
+    # auto never warns — it just resolves.
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ModelSpec.from_config(FmConfig(order=3))
+
+
 def test_unknown_key_fails_loudly(tmp_path):
     path = write_cfg(tmp_path, """
         [General]
