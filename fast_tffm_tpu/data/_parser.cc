@@ -68,7 +68,8 @@ struct ShardOut {
   std::vector<int32_t> sizes;  // per-example nnz
   std::vector<int32_t> ids;
   std::vector<float> vals;
-  std::vector<int32_t> fields;  // field-aware (FFM) mode only
+  std::vector<int32_t> fields;   // field-aware (FFM) mode only
+  std::vector<int64_t> linenos;  // per-example 1-based line number
   bool failed = false;
   std::string error;
 };
@@ -300,10 +301,14 @@ inline int parse_token(const char* q, const char* tok_end,
 }
 
 // Parse lines [begin, end) of the blob (byte offsets of line starts are
-// implicit: we scan). `first_lineno` is for error messages only.
+// implicit: we scan). `first_lineno` seeds the per-example line numbers
+// (and error messages). `keep_empty` turns blank lines into
+// zero-feature label-0 examples (the BatchBuilder's predict-alignment
+// mode); otherwise blanks are dropped.
 void parse_range(const char* blob, const char* end, int64_t first_lineno,
                  int64_t vocab, bool hash_ids, bool field_aware,
-                 int64_t field_num, int max_feats, ShardOut* out) {
+                 int64_t field_num, int max_feats, bool keep_empty,
+                 ShardOut* out) {
   const char* p = blob;
   int64_t lineno = first_lineno;
   while (p < end) {
@@ -311,10 +316,13 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
         std::memchr(p, '\n', size_t(end - p)));
     if (line_end == nullptr) line_end = end;
     const char* q = p;
-    // skip leading whitespace; blank lines are dropped (training path;
-    // keep_empty goes through the Python parser)
     while (q < line_end && is_ws(*q)) q++;
     if (q == line_end) {
+      if (keep_empty) {
+        out->labels.push_back(0.0f);
+        out->sizes.push_back(0);
+        out->linenos.push_back(lineno);
+      }
       p = line_end + 1;
       lineno++;
       continue;
@@ -356,9 +364,58 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
       q = tok_end;
     }
     out->sizes.push_back(n_feats);
+    out->linenos.push_back(lineno);
     p = line_end + 1;
     lineno++;
   }
+}
+
+// Slice [blob, end) into <= T line-aligned ranges and parse them on T
+// threads. Returns the shard outputs in order. Shared by fm_parse_block
+// and the threaded BatchBuilder feed path.
+std::vector<ShardOut> parse_threaded(const char* blob, const char* end,
+                                     int64_t first_lineno, int T,
+                                     int64_t vocab, bool hash_ids,
+                                     bool field_aware, int64_t field_num,
+                                     int max_feats, bool keep_empty) {
+  const int64_t blob_len = end - blob;
+  std::vector<const char*> starts{blob};
+  for (int t = 1; t < T; t++) {
+    const char* target = blob + blob_len * t / T;
+    if (target <= starts.back()) continue;
+    const char* nl = static_cast<const char*>(
+        std::memchr(target, '\n', size_t(end - target)));
+    const char* start = nl ? nl + 1 : end;
+    if (start > starts.back()) starts.push_back(start);
+  }
+  starts.push_back(end);
+  int shards = int(starts.size()) - 1;
+
+  // Line-number offsets per shard (error messages + pending linenos).
+  std::vector<int64_t> lineno0(size_t(shards), first_lineno);
+  for (int s = 1; s < shards; s++) {
+    int64_t count = 0;
+    for (const char* c = starts[s - 1]; c < starts[s]; c++) {
+      if (*c == '\n') count++;
+    }
+    lineno0[size_t(s)] = lineno0[size_t(s - 1)] + count;
+  }
+
+  std::vector<ShardOut> outs(static_cast<size_t>(shards));
+  if (shards == 1) {
+    parse_range(starts[0], starts[1], lineno0[0], vocab, hash_ids,
+                field_aware, field_num, max_feats, keep_empty, &outs[0]);
+    return outs;
+  }
+  std::vector<std::thread> threads;
+  for (int s = 0; s < shards; s++) {
+    threads.emplace_back(parse_range, starts[size_t(s)],
+                         starts[size_t(s) + 1], lineno0[size_t(s)], vocab,
+                         hash_ids, field_aware, field_num, max_feats,
+                         keep_empty, &outs[size_t(s)]);
+  }
+  for (auto& th : threads) th.join();
+  return outs;
 }
 
 }  // namespace
@@ -372,8 +429,9 @@ extern "C" {
 // History: 1 = initial; 2 = field-aware (FFM) params + fields buffers;
 // 3 = raw_ids builder mode (dedup=device); 4 = keep_empty builder mode
 // (blank line -> zero-feature example; the predict path's line
-// alignment).
-int64_t fm_abi_version() { return 4; }
+// alignment); 5 = fm_bb_new num_threads param (threaded streaming
+// feed: parallel parse into a pending queue + serial drain).
+int64_t fm_abi_version() { return 5; }
 
 // Returns 0 on success. Outputs:
 //   labels[n_examples], poses[n_examples+1], ids[nnz], vals[nnz]
@@ -398,41 +456,9 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
   if (T < 1) T = 1;
   if (blob_len < (64 << 10)) T = 1;  // small blocks: threading overhead
 
-  // Slice the blob into T ranges on line boundaries.
-  std::vector<const char*> starts{blob};
-  const char* end = blob + blob_len;
-  for (int t = 1; t < T; t++) {
-    const char* target = blob + blob_len * t / T;
-    if (target <= starts.back()) {
-      continue;
-    }
-    const char* nl = static_cast<const char*>(
-        std::memchr(target, '\n', size_t(end - target)));
-    const char* start = nl ? nl + 1 : end;
-    if (start > starts.back()) starts.push_back(start);
-  }
-  starts.push_back(end);
-  int shards = int(starts.size()) - 1;
-
-  // Line numbers per shard for error messages: count newlines up front.
-  std::vector<int64_t> first_lineno(size_t(shards), 0);
-  for (int s = 1; s < shards; s++) {
-    int64_t count = 0;
-    for (const char* c = starts[s - 1]; c < starts[s]; c++) {
-      if (*c == '\n') count++;
-    }
-    first_lineno[size_t(s)] = first_lineno[size_t(s - 1)] + count;
-  }
-
-  std::vector<ShardOut> outs(static_cast<size_t>(shards));
-  std::vector<std::thread> threads;
-  for (int s = 0; s < shards; s++) {
-    threads.emplace_back(parse_range, starts[size_t(s)],
-                         starts[size_t(s) + 1], first_lineno[size_t(s)],
-                         vocab, hash_ids != 0, field_aware != 0, field_num,
-                         max_feats, &outs[size_t(s)]);
-  }
-  for (auto& th : threads) th.join();
+  std::vector<ShardOut> outs = parse_threaded(
+      blob, blob + blob_len, 0, T, vocab, hash_ids != 0, field_aware != 0,
+      field_num, max_feats, /*keep_empty=*/false);
 
   for (const auto& o : outs) {
     if (o.failed) {
@@ -491,6 +517,7 @@ struct BatchBuilder {
   int64_t field_num = 0;
   int max_feats;
   int64_t max_uniq;  // 0 = unlimited; else batch closes BEFORE exceeding
+  int T = 1;         // feed parse threads (1 = the serial in-line path)
   std::vector<float> labels;    // [B]
   std::vector<int32_t> uniq;    // [B*L + 1]
   std::vector<int32_t> li;      // [B*L], default 0 (pad slot)
@@ -506,6 +533,23 @@ struct BatchBuilder {
   int32_t max_nnz = 0;
   int64_t lineno = 0;
   std::string error;
+  // Threaded feed (T > 1): each fed chunk's complete lines are parsed
+  // by T threads into this pending CSR queue (the expensive tokenize/
+  // float-parse/hash phase); a cheap serial drain then does the
+  // order-dependent work (dedup slots, padded scatter, uniq-budget
+  // spill). A parse error is DEFERRED: examples before it drain
+  // normally and the error surfaces only when consumption reaches it —
+  // the exact observable behavior of the serial path.
+  std::vector<float> p_labels;
+  std::vector<int32_t> p_sizes;
+  std::vector<int64_t> p_linenos;
+  std::vector<int32_t> p_ids;
+  std::vector<float> p_vals;
+  std::vector<int32_t> p_fields;
+  size_t p_cursor = 0;      // next pending example
+  size_t p_nnz = 0;         // its flat ids/vals offset
+  bool p_failed = false;
+  std::string p_error;
 };
 
 namespace {
@@ -551,13 +595,152 @@ inline void bb_rollback_line(BatchBuilder* bb, int32_t saved_uniq) {
   bb->n_uniq = saved_uniq;
 }
 
+// The unique-budget close-out, shared by the serial feed and the
+// threaded drain so the spill protocol (rollback + row scrub + the
+// budget error message) has exactly one implementation. Returns 1 when
+// the batch closes early (spill — the example stays unconsumed), -1
+// when the batch is empty so the example can never fit (error).
+inline int bb_budget_close(BatchBuilder* bb, int32_t* irow, float* vrow,
+                           int32_t* frow, int32_t nf, int32_t saved_uniq,
+                           int64_t lineno, char* err_out,
+                           int64_t err_cap) {
+  bb_rollback_line(bb, saved_uniq);
+  std::memset(irow, 0, size_t(nf) * sizeof(int32_t));
+  std::memset(vrow, 0, size_t(nf) * sizeof(float));
+  if (frow != nullptr) std::memset(frow, 0, size_t(nf) * sizeof(int32_t));
+  if (bb->n_ex == 0) {
+    std::snprintf(err_out, size_t(err_cap),
+                  "line %lld: single example exceeds the unique-row "
+                  "budget %lld; raise uniq_bucket",
+                  (long long)lineno, (long long)bb->max_uniq);
+    return -1;
+  }
+  return 1;
+}
+
+// Drain pending (threaded-parse) examples into the batch. Returns 1
+// when the batch is full or closed early on the unique budget, 0 when
+// pending is exhausted with room left, -1 when consumption reaches a
+// deferred parse error (message to err_out).
+int bb_drain(BatchBuilder* bb, char* err_out, int64_t err_cap) {
+  while (bb->n_ex < bb->B) {
+    if (bb->p_cursor >= bb->p_sizes.size()) {
+      if (bb->p_failed) {
+        std::snprintf(err_out, size_t(err_cap), "%s",
+                      bb->p_error.c_str());
+        return -1;
+      }
+      return 0;
+    }
+    const size_t e = bb->p_cursor;
+    const int32_t nf = bb->p_sizes[e];
+    const int32_t* ids = bb->p_ids.data() + bb->p_nnz;
+    const float* vals = bb->p_vals.data() + bb->p_nnz;
+    const int32_t* flds =
+        bb->field_aware ? bb->p_fields.data() + bb->p_nnz : nullptr;
+    float* vrow = bb->vals.data() + bb->n_ex * bb->L;
+    int32_t* irow = bb->li.data() + bb->n_ex * bb->L;
+    int32_t* frow =
+        bb->field_aware ? bb->fields.data() + bb->n_ex * bb->L : nullptr;
+    bb->line_slots.clear();
+    const int32_t saved_uniq = bb->n_uniq;
+    for (int32_t j = 0; j < nf; j++) {
+      irow[j] = bb->raw_ids ? ids[j] : bb_slot(bb, ids[j]);
+      vrow[j] = vals[j];
+      if (frow != nullptr) frow[j] = flds[j];
+    }
+    if (bb->max_uniq != 0 && bb->n_uniq > bb->max_uniq) {
+      return bb_budget_close(bb, irow, vrow, frow, nf, saved_uniq,
+                             bb->p_linenos[e], err_out, err_cap);
+    }
+    bb->labels[size_t(bb->n_ex)] = bb->p_labels[e];
+    if (nf > bb->max_nnz) bb->max_nnz = nf;
+    bb->n_ex++;
+    bb->p_cursor++;
+    bb->p_nnz += size_t(nf);
+  }
+  return 1;
+}
+
+// Threaded feed: parse every complete line of the chunk in parallel
+// into pending, then drain. Consumes up to the last newline regardless
+// of where the batch fills (excess examples wait in pending; deferred
+// errors wait for their turn).
+int bb_feed_threaded(BatchBuilder* bb, const char* blob, int64_t blob_len,
+                     int64_t* consumed_out, char* err_out,
+                     int64_t err_cap) {
+  *consumed_out = 0;
+  int rc = bb_drain(bb, err_out, err_cap);
+  if (rc != 0) return rc;  // full from pending alone, or deferred error
+  const char* end0 = blob + blob_len;
+  // Last complete line: search the final newline from the back.
+  const char* last_nl = nullptr;
+  for (const char* c = end0 - 1; c >= blob; c--) {
+    if (*c == '\n') {
+      last_nl = c;
+      break;
+    }
+  }
+  if (last_nl == nullptr) return 0;  // no complete line: need more bytes
+  const char* end = last_nl + 1;
+
+  bb->p_labels.clear();
+  bb->p_sizes.clear();
+  bb->p_linenos.clear();
+  bb->p_ids.clear();
+  bb->p_vals.clear();
+  bb->p_fields.clear();
+  bb->p_cursor = 0;
+  bb->p_nnz = 0;
+  bb->p_failed = false;
+
+  // Small feeds (EOF tails, tiny files) don't amortize thread spawns —
+  // the same 64 KB cutoff fm_parse_block uses.
+  const int T = (end - blob) < (64 << 10) ? 1 : bb->T;
+  std::vector<ShardOut> outs = parse_threaded(
+      blob, end, bb->lineno + 1, T, bb->vocab, bb->hash_ids,
+      bb->field_aware, bb->field_num, bb->max_feats, bb->keep_empty);
+  for (const char* c = blob; c < end; c++) {
+    if (*c == '\n') bb->lineno++;
+  }
+  for (const auto& o : outs) {
+    // A failed shard still contributes the examples it completed
+    // before the error (labels may hold one half-parsed extra entry;
+    // sizes is the count of COMPLETE examples).
+    const size_t n_ok = o.sizes.size();
+    int64_t nnz_ok = 0;
+    for (size_t i = 0; i < n_ok; i++) nnz_ok += o.sizes[i];
+    bb->p_labels.insert(bb->p_labels.end(), o.labels.begin(),
+                        o.labels.begin() + std::ptrdiff_t(n_ok));
+    bb->p_sizes.insert(bb->p_sizes.end(), o.sizes.begin(), o.sizes.end());
+    bb->p_linenos.insert(bb->p_linenos.end(), o.linenos.begin(),
+                         o.linenos.end());
+    bb->p_ids.insert(bb->p_ids.end(), o.ids.begin(),
+                     o.ids.begin() + std::ptrdiff_t(nnz_ok));
+    bb->p_vals.insert(bb->p_vals.end(), o.vals.begin(),
+                      o.vals.begin() + std::ptrdiff_t(nnz_ok));
+    if (bb->field_aware) {
+      bb->p_fields.insert(bb->p_fields.end(), o.fields.begin(),
+                          o.fields.begin() + std::ptrdiff_t(nnz_ok));
+    }
+    if (o.failed) {
+      bb->p_failed = true;
+      bb->p_error = o.error;
+      break;  // later shards' examples come after the error: dropped
+    }
+  }
+  *consumed_out = end - blob;
+  return bb_drain(bb, err_out, err_cap);
+}
+
 }  // namespace
 
 extern "C" {
 
 void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
                 int field_aware, int64_t field_num, int raw_ids,
-                int keep_empty, int max_feats, int64_t max_uniq) {
+                int keep_empty, int max_feats, int64_t max_uniq,
+                int num_threads) {
   if (B <= 0 || L <= 0 || vocab <= 0) return nullptr;
   if (field_aware != 0 && field_num <= 0) return nullptr;
   // raw_ids skips dedup entirely; the fixed-U spill protocol needs the
@@ -580,6 +763,13 @@ void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
     return nullptr;
   }
   bb->max_uniq = max_uniq;
+  // Thread count for the feed parse phase (0 = auto). T == 1 keeps the
+  // original single-pass loop — on a 1-core host the phase-split would
+  // only add buffer traffic.
+  int T = num_threads > 0
+              ? num_threads
+              : int(std::min(8u, std::thread::hardware_concurrency()));
+  bb->T = T < 1 ? 1 : T;
   bb->labels.resize(size_t(B));
   bb->uniq.resize(size_t(B * L + 1));
   bb->uniq[0] = int32_t(vocab);  // pad slot
@@ -605,6 +795,10 @@ void fm_bb_free(void* h) { delete static_cast<BatchBuilder*>(h); }
 int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
                int64_t* consumed_out, char* err_out, int64_t err_cap) {
   auto* bb = static_cast<BatchBuilder*>(h);
+  if (bb->T > 1) {
+    return bb_feed_threaded(bb, blob, blob_len, consumed_out, err_out,
+                            err_cap);
+  }
   const char* p = blob;
   const char* end = blob + blob_len;
   while (bb->n_ex < bb->B) {
@@ -671,20 +865,12 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
       // roll it back, close the batch early (spill protocol — the line
       // is left unconsumed and opens the next batch). fm_bb_new
       // guarantees a single line always fits an empty batch.
-      bb_rollback_line(bb, saved_uniq);
-      std::memset(irow, 0, size_t(n_feats) * sizeof(int32_t));
-      std::memset(vrow, 0, size_t(n_feats) * sizeof(float));
-      if (frow != nullptr) {
-        std::memset(frow, 0, size_t(n_feats) * sizeof(int32_t));
-      }
+      const int64_t spill_lineno = bb->lineno;
       bb->lineno--;  // will be re-fed
-      if (bb->n_ex == 0) {
-        std::snprintf(err_out, size_t(err_cap),
-                      "line %lld: single example exceeds the unique-row "
-                      "budget %lld; raise uniq_bucket",
-                      (long long)(bb->lineno + 1), (long long)bb->max_uniq);
-        return -1;
-      }
+      const int rc = bb_budget_close(bb, irow, vrow, frow, n_feats,
+                                     saved_uniq, spill_lineno, err_out,
+                                     err_cap);
+      if (rc < 0) return -1;
       *consumed_out = p - blob;
       return 1;
     }

@@ -52,7 +52,7 @@ def _build() -> None:
 
 # Must equal fm_abi_version() in _parser.cc. Bump both together whenever
 # an exported signature changes.
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 
 def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
@@ -150,7 +150,8 @@ def _load() -> ctypes.CDLL:
                                   ctypes.c_int, ctypes.c_int64,  # field flag, count
                                   ctypes.c_int,                  # raw_ids
                                   ctypes.c_int,                  # keep_empty
-                                  ctypes.c_int, ctypes.c_int64]
+                                  ctypes.c_int, ctypes.c_int64,
+                                  ctypes.c_int]                  # num_threads
         lib.fm_bb_free.argtypes = [ctypes.c_void_p]
         lib.fm_bb_feed.restype = ctypes.c_int
         lib.fm_bb_feed.argtypes = [
@@ -230,7 +231,8 @@ class BatchBuilder:
                  vocabulary_size: int, hash_feature_id: bool = False,
                  field_aware: bool = False, field_num: int = 0,
                  raw_ids: bool = False, keep_empty: bool = False,
-                 max_features_per_example: int = 0, max_uniq: int = 0):
+                 max_features_per_example: int = 0, max_uniq: int = 0,
+                 num_threads: int = 0):
         """``max_uniq`` > 0 caps the batch's unique-row count (incl. the
         pad slot): a line that would exceed it closes the batch early
         (spill) and opens the next one — the fixed-U protocol for
@@ -241,7 +243,10 @@ class BatchBuilder:
         = vocabulary_size) and finish() returns uniq=None; incompatible
         with max_uniq. ``keep_empty`` turns blank lines into
         zero-feature examples (label 0) — the predict path's
-        one-score-per-input-line alignment."""
+        one-score-per-input-line alignment. ``num_threads`` sets the
+        feed parse-thread count (0 = auto: min(8, cores)); with more
+        than one thread each fed chunk is parsed in parallel and
+        drained serially, with byte-identical outputs."""
         self._lib = _load()
         self.B, self.L = batch_size, max_cols
         self.field_aware = field_aware
@@ -252,7 +257,7 @@ class BatchBuilder:
                                       int(field_aware), field_num,
                                       int(raw_ids), int(keep_empty),
                                       max_features_per_example,
-                                      max_uniq)
+                                      max_uniq, num_threads)
         if not self._h:
             # ValueError, not RuntimeError: the extension IS available,
             # the arguments are wrong — callers must not read this as

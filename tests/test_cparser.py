@@ -219,3 +219,137 @@ def test_float_grammar_parity_edges():
             parse_lines(bad, 10)
         with pytest.raises(ParseError):
             cparser.parse_lines_fast(bad, 10)
+
+
+# --- threaded streaming BatchBuilder (feed parse threads) -------------------
+
+
+def _run_builder(blob, chunks, num_threads, **kw):
+    """Drive a BatchBuilder over byte chunks; returns (batches, error)."""
+    bb = cparser.BatchBuilder(4, 8, 500, num_threads=num_threads, **kw)
+    out, tail = [], b""
+
+    def feed_all(dat):
+        off = 0
+        while True:
+            full, consumed = bb.feed(dat, off)
+            off += consumed
+            if not full:
+                break
+            out.append(bb.finish())
+        return dat[off:]
+
+    try:
+        for c in chunks:
+            tail = feed_all(tail + c)
+        if tail:
+            feed_all(tail + b"\n")
+        final = bb.finish()
+        if final[0]:
+            out.append(final)
+        return out, None
+    except ParseError as e:
+        return out, str(e)
+
+
+def _assert_batches_equal(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        n = g[0]
+        assert n == w[0]
+        np.testing.assert_array_equal(g[1][:n], w[1][:n])  # labels
+        if w[2] is None:
+            assert g[2] is None
+        else:
+            np.testing.assert_array_equal(g[2], w[2])      # uniq
+        np.testing.assert_array_equal(g[3], w[3])          # local_idx
+        np.testing.assert_array_equal(g[4], w[4])          # vals
+        if w[5] is not None:
+            np.testing.assert_array_equal(g[5], w[5])      # fields
+
+
+def _builder_corpus(rng, n_lines=37, field_aware=False, blanks=True):
+    lines = []
+    for i in range(n_lines):
+        if blanks and i % 9 == 4:
+            lines.append("")
+            continue
+        nnz = int(rng.integers(0, 7))
+        ids = rng.choice(500, size=nnz, replace=False)
+        toks = [str(int(rng.integers(0, 2)))]
+        for j in ids:
+            t = f"{j}:{rng.random():.3f}"
+            if field_aware:
+                t = f"{int(rng.integers(0, 3))}:{t}"
+            toks.append(t)
+        lines.append(" ".join(toks))
+    return ("\n".join(lines) + "\n").encode()
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),
+    dict(hash_feature_id=True),
+    dict(raw_ids=True),
+    dict(keep_empty=True),
+    dict(field_aware=True, field_num=3),
+    dict(max_uniq=16, max_features_per_example=8),
+])
+def test_threaded_builder_matches_serial(rng, kw):
+    """T=4 feed parsing (parallel parse + serial drain) produces
+    byte-identical batches to T=1 in every builder mode, across chunked
+    feeds (VERDICT r3 next-round #3)."""
+    blob = _builder_corpus(rng, field_aware=kw.get("field_aware", False))
+    for chunks in ([blob], [blob[:97], blob[97:301], blob[301:]],
+                   [blob[i:i + 53] for i in range(0, len(blob), 53)]):
+        want, err_w = _run_builder(blob, [blob], 1, **kw)
+        got, err_g = _run_builder(blob, chunks, 4, **kw)
+        assert (err_w is None) == (err_g is None)
+        _assert_batches_equal(got, want)
+
+
+def test_threaded_builder_defers_parse_error(rng):
+    """A bad line mid-stream: the threaded path emits every batch that
+    precedes the error, then raises — exactly the serial path's
+    observable behavior (errors are deferred to their turn, not raised
+    at parse time)."""
+    good = _builder_corpus(rng, n_lines=11, blanks=False)
+    blob = good + b"1 bad:token:xx:yy\n" + _builder_corpus(
+        rng, n_lines=7, blanks=False)
+    want, err_w = _run_builder(blob, [blob], 1)
+    got, err_g = _run_builder(blob, [blob[:40], blob[40:]], 4)
+    assert err_w is not None and err_g is not None
+    assert err_w == err_g  # same message incl. the 1-based line number
+    _assert_batches_equal(got, want)
+
+
+def test_threaded_builder_scales(rng):
+    """host-side build rate must scale with parse threads (>= 1.5x at
+    T=4). Skipped where the cores to show it don't exist."""
+    if (os.cpu_count() or 1) < 4:
+        pytest.skip("needs >= 4 cores to measure scaling")
+    import time
+    lines = []
+    for i in range(40000):
+        ids = rng.choice(100000, size=39, replace=False)
+        lines.append("1 " + " ".join(f"{j}:1.5" for j in ids))
+    blob = ("\n".join(lines) + "\n").encode()
+
+    def rate(T):
+        bb = cparser.BatchBuilder(8192, 48, 1 << 20, num_threads=T,
+                                  max_features_per_example=48)
+        t0 = time.perf_counter()
+        off = 0
+        while True:
+            full, consumed = bb.feed(blob, off)
+            off += consumed
+            if not full:
+                break
+            bb.finish()
+        bb.finish()
+        return len(lines) / (time.perf_counter() - t0)
+
+    # Best of 3 per thread count: a transient load spike on a shared
+    # host must not read as a scaling regression.
+    r1 = max(rate(1) for _ in range(3))
+    r4 = max(rate(4) for _ in range(3))
+    assert r4 >= 1.5 * r1, f"T=4 {r4:.0f}/s vs T=1 {r1:.0f}/s"
