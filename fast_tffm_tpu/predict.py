@@ -104,19 +104,16 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
     backend = None
     if cfg.lookup == "host":
         # Offload predict (lookup.py seam): restore (or wrap a
-        # caller-supplied table) straight into host RAM; the device only
-        # ever sees per-batch [U, D] row blocks. Routing a provided
-        # table to the device paths here would materialize the
+        # caller-supplied table) into the best offload backend — pinned
+        # accelerator-host memory where supported, local numpy else; the
+        # device only ever sees per-batch [U, D] row blocks. Routing a
+        # provided table to the device paths here would materialize the
         # offload-scale table in HBM — the exact OOM this mode avoids.
-        from fast_tffm_tpu.lookup import HostOffloadLookup
-        if table is None:
-            backend = HostOffloadLookup.from_checkpoint(cfg,
-                                                        with_acc=False)
-        else:
-            backend = HostOffloadLookup.for_table(cfg, table)
-            table = None
-        logger.info("host-offload predict: table [%d, %d] in host RAM",
-                    *backend.table.shape)
+        from fast_tffm_tpu.lookup import make_score_backend
+        backend = make_score_backend(cfg, table)
+        table = None
+        logger.info("offload predict [%s]: table [%d, %d] outside HBM",
+                    type(backend).__name__, *backend.table.shape)
     elif jax.device_count() > 1:
         from fast_tffm_tpu.parallel.sharded import make_mesh, place_table
         try:

@@ -23,7 +23,8 @@ import numpy as np
 from fast_tffm_tpu.checkpoint import CheckpointState, export_npz
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.pipeline import (SPILL_WARN_FRACTION, SpillStats,
-                                         batch_iterator, prefetch)
+                                         batch_iterator, prefetch,
+                                         uniq_bucket_top)
 from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
@@ -142,13 +143,15 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     multi_process = jax.process_count() > 1
     offload = cfg.lookup == "host"
     if offload and multi_process:
-        # Multi-host offload would row-shard the host table across
-        # processes (each host serving its row range, a literal PS) —
-        # not built; the device mesh already covers multi-chip scale.
+        # Design position, not a gap: any multi-host v5e job has >= 8
+        # chips, whose aggregate HBM covers config #5's 72 GB state
+        # row-sharded (BASELINE.md "Design note: multi-host beyond-HBM
+        # is covered by the mesh"); a cross-process host-RAM table would
+        # re-implement the mesh with a slower transport.
         raise ValueError(
-            "lookup = host is single-process: the host-RAM table has no "
-            "cross-process sharding; use lookup = device for distributed "
-            "training")
+            "lookup = host is single-process by design: multi-host scale "
+            "uses the row-sharded mesh (lookup = device) — see "
+            "BASELINE.md's multi-host beyond-HBM design note")
     mesh = None
     if jax.device_count() > 1 and not offload:
         # More than one device (one host of a TPU slice, or the whole
@@ -201,33 +204,28 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         logger.info("restored checkpoint at step %d", global_step)
     lk = None
     if offload:
-        # Host-offload backend (lookup.py; BASELINE config #5): the
-        # table/accumulator stay in host RAM, the jitted device program
-        # is grad_body — [U, D] rows in, loss/scores/row-grads out — and
-        # the host applies the sparse Adagrad update.
-        from fast_tffm_tpu.lookup import HostOffloadLookup
-        from fast_tffm_tpu.models.fm import make_grad_fn
-        if restored is not None:
-            lk = HostOffloadLookup(cfg, _init=False)
-            lk.load(np.asarray(restored["table"]),
-                    np.asarray(restored["acc"]))
-        else:
-            lk = HostOffloadLookup(cfg, cfg.seed)
-        logger.info("host-offload lookup: table [%d, %d] in host RAM "
-                    "(%.2f GB + accumulator)", lk.rows, lk.dim,
+        # Offload backend (lookup.py; BASELINE config #5): the table/
+        # accumulator live outside HBM. make_offload_backend picks the
+        # in-jit pinned-host implementation (whole step stays in the
+        # async dispatch stream) where the backend compiles it, else the
+        # numpy fallback with its inherent per-step gradient fetch.
+        from fast_tffm_tpu.lookup import (PinnedHostLookup,
+                                          make_offload_backend,
+                                          make_offload_train_step)
+        lk = make_offload_backend(cfg, cfg.seed, restored=restored)
+        kind = (f"pinned-host in-jit ({lk.mode})"
+                if isinstance(lk, PinnedHostLookup) else "host-numpy")
+        logger.info("offload lookup [%s]: table [%d, %d] outside HBM "
+                    "(%.2f GB + accumulator)", kind, lk.rows, lk.dim,
                     lk.rows * lk.dim * 4 / 2**30)
-        grad_fn = make_grad_fn(spec)
+        offload_step = make_offload_train_step(spec, lk,
+                                               cfg.learning_rate)
         table = acc = None
 
         def step_fn(_t, _a, labels, weights, uniq_ids, local_idx, vals,
                     fields=None):
-            gathered = lk.gather(uniq_ids)
-            loss, scores, grad = grad_fn(gathered, labels, weights,
-                                         uniq_ids, local_idx, vals,
-                                         fields)
-            # np.asarray blocks on the device grad — inherent to
-            # offload: the host update needs the bytes.
-            lk.apply_grad(uniq_ids, np.asarray(grad), cfg.learning_rate)
+            loss, scores = offload_step(labels, weights, uniq_ids,
+                                        local_idx, vals, fields)
             return None, None, loss, scores
     elif mesh is not None:
         if restored is not None:
@@ -317,6 +315,10 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             float(probe)
             cost = min(cost, _time.perf_counter() - t0)
         if cost < LIVE_FETCH_BUDGET_S:
+            # Log the decision either way: a user wondering why loss
+            # lines are (or aren't) live gets the probe's answer.
+            logger.info("scalar fetch costs %.3f ms on this device link; "
+                        "loss log lines stay live", cost * 1e3)
             return "live"
         logger.info(
             "scalar fetch costs %.0f ms on this device link; deferring "
@@ -445,6 +447,19 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         "unique-row budget; raise uniq_bucket (or set 0 "
                         "to re-probe) to recover effective batch size",
                         uniq_bucket, 100 * epoch_stats.spill_fraction)
+            if multi_process and not stopping and epoch + 1 < cfg.epoch_num:
+                # Adaptive bucket: a probe-missed dense stretch spills
+                # every epoch otherwise. The job-wide spill fraction is
+                # allgathered (per-process stats see only their own
+                # shard — a local decision would desynchronize shapes
+                # and deadlock the collective program), and every
+                # process applies the same doubling.
+                from jax.experimental import multihost_utils
+                tot = multihost_utils.process_allgather(np.asarray(
+                    [epoch_stats.spilled_batches, epoch_stats.batches]))
+                tot = tot.reshape(-1, 2).sum(axis=0)
+                uniq_bucket = adapt_uniq_bucket(
+                    cfg, uniq_bucket, int(tot[0]), int(tot[1]), logger)
             if cfg.validation_files and not stopping:
                 vmb = cfg.validation_max_batches or None
                 if multi_process:
@@ -507,7 +522,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     if offload:
         # The logical table as host numpy (the offload analogue of the
         # device table return; dead ckpt-alignment tail sliced off).
-        return lk.table[:cfg.num_rows]
+        # The pinned backend's table is a jax array in accelerator-host
+        # memory: fetch it (callers of train() expect host bytes; at
+        # true config-#5 scale callers use the checkpoint instead).
+        tbl = (lk.table if isinstance(lk.table, np.ndarray)
+               else np.asarray(jax.device_get(lk.table)))
+        return tbl[:cfg.num_rows]
     return table
 
 
@@ -516,6 +536,30 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
 # dense — materializing it on one host is exactly what the sharded
 # design exists to avoid.
 EXPORT_NPZ_MAX_BYTES = 2 << 30
+
+
+def adapt_uniq_bucket(cfg: FmConfig, uniq_bucket: int, spilled: int,
+                      batches: int, logger) -> int:
+    """Next epoch's fixed unique-row bucket, given THIS epoch's job-wide
+    spill counts: double (up to the worst-case ladder top) while the
+    spill fraction stays above SPILL_WARN_FRACTION. Deterministic in its
+    inputs — callers must feed every process the same totals (train()
+    allgathers them) so all agree on the new batch shapes without
+    negotiation. An explicit ``uniq_bucket`` config is never overridden.
+    """
+    if cfg.uniq_bucket or not batches:
+        return uniq_bucket
+    if spilled / batches <= SPILL_WARN_FRACTION:
+        return uniq_bucket
+    top = uniq_bucket_top(cfg)
+    if uniq_bucket >= top:
+        return uniq_bucket
+    new_bucket = min(uniq_bucket * 2, top)
+    logger.info(
+        "raising uniq_bucket %d -> %d for the next epoch (%.0f%% of "
+        "batches spilled on the unique-row budget this epoch)",
+        uniq_bucket, new_bucket, 100 * spilled / batches)
+    return new_bucket
 
 
 def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,

@@ -11,7 +11,10 @@ import pytest
 import run_tffm
 from fast_tffm_tpu.config import FmConfig, load_config
 from fast_tffm_tpu.data.pipeline import batch_iterator
-from fast_tffm_tpu.lookup import HostOffloadLookup, memory_report
+from fast_tffm_tpu.lookup import (HostOffloadLookup, PinnedHostLookup,
+                                  make_offload_backend,
+                                  make_offload_train_step, memory_report,
+                                  probe_placement_mode)
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
                                      init_accumulator, init_table,
                                      make_grad_fn, make_train_step)
@@ -168,6 +171,115 @@ def test_predict_with_caller_table_stays_host_side(host_cfg_files):
     np.testing.assert_allclose(s1, s2, atol=1e-6)
     with pytest.raises(ValueError, match="layout"):
         HostOffloadLookup.for_table(cfg, np.zeros((5, 5), np.float32))
+
+
+def test_placement_probe_resolves_on_cpu():
+    """The hermetic CPU platform supports the un-annotated program
+    structure ("plain" — device memory IS host RAM there); the chooser
+    must therefore pick the in-jit backend."""
+    assert probe_placement_mode() == "plain"
+    cfg = FmConfig(vocabulary_size=100, factor_num=4)
+    lk = make_offload_backend(cfg, seed=0)
+    assert isinstance(lk, PinnedHostLookup)
+    assert lk.mode == "plain"
+
+
+def test_pinned_backend_matches_device_step_for_step(tmp_path, rng):
+    """N steps through the FUSED in-jit offload program == N steps
+    through the fused device jit, batch for batch — the parity contract
+    the numpy backend already meets, now for the pinned one (VERDICT r3
+    next-round #1)."""
+    make_dataset(tmp_path / "train.txt", 200, rng)
+    cfg = _cfg(tmp_path)
+    spec = ModelSpec.from_config(cfg)
+
+    table = init_table(cfg, cfg.seed)
+    acc = init_accumulator(cfg)
+    step = make_train_step(spec)
+
+    lk = PinnedHostLookup(cfg, cfg.seed)
+    off_step = make_offload_train_step(spec, lk, cfg.learning_rate)
+
+    for batch in batch_iterator(cfg, cfg.train_files, training=True,
+                                epochs=1):
+        args = batch_args(batch)
+        table, acc, loss_d, _ = step(table, acc, **args)
+        loss_p, _ = off_step(**args)
+        assert float(loss_d) == pytest.approx(float(loss_p), abs=1e-6)
+
+    t_p, a_p = (np.asarray(x) for x in lk.state())
+    np.testing.assert_allclose(t_p[:cfg.num_rows], np.asarray(table),
+                               atol=2e-6)
+    np.testing.assert_allclose(a_p[:cfg.num_rows], np.asarray(acc),
+                               atol=2e-6)
+
+
+def test_pinned_seam_methods_match_numpy_backend(tmp_path, rng):
+    """gather/apply_grad seam parity: PinnedHostLookup and
+    HostOffloadLookup are drop-in interchangeable (same init stream,
+    same rows, same post-update state)."""
+    make_dataset(tmp_path / "train.txt", 100, rng)
+    cfg = _cfg(tmp_path)
+    lk_np = HostOffloadLookup(cfg, cfg.seed)
+    lk_pin = PinnedHostLookup(cfg, cfg.seed)
+    batch = next(batch_iterator(cfg, cfg.train_files, training=True,
+                                epochs=1))
+    ids = batch.uniq_ids
+    np.testing.assert_allclose(np.asarray(lk_pin.gather(ids)),
+                               lk_np.gather(ids), atol=1e-7)
+    grad = rng.normal(0, 0.1, size=(len(ids), cfg.row_dim)).astype(
+        np.float32)
+    grad[ids >= cfg.vocabulary_size] = 0.0  # pad rows carry zero grads
+    lk_np.apply_grad(ids, grad, cfg.learning_rate)
+    lk_pin.apply_grad(ids, grad, cfg.learning_rate)
+    np.testing.assert_allclose(np.asarray(lk_pin.table), lk_np.table,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(lk_pin.acc), lk_np.acc,
+                               atol=1e-6)
+
+
+def test_pinned_backend_ffm_fused_step(tmp_path, rng):
+    """The fused offload program handles the FFM model family (fields
+    threaded through grad_body) — config #3 x config #5 composition."""
+    import dataclasses
+    from tests.test_e2e import make_dataset as _mk
+    lines = []
+    for _ in range(64):
+        toks = [f"{f}:{int(rng.integers(0, 50))}" for f in range(3)]
+        lines.append(" ".join([str(int(rng.integers(0, 2)))] + toks))
+    (tmp_path / "train.txt").write_text("\n".join(lines) + "\n")
+    cfg = _cfg(tmp_path, vocabulary_size=50, model_type="ffm",
+               field_num=3, factor_num=2, batch_size=16)
+    spec = ModelSpec.from_config(cfg)
+    table = init_table(cfg, cfg.seed)
+    acc = init_accumulator(cfg)
+    step = make_train_step(spec)
+    lk = PinnedHostLookup(cfg, cfg.seed)
+    off_step = make_offload_train_step(spec, lk, cfg.learning_rate)
+    for batch in batch_iterator(cfg, cfg.train_files, training=True,
+                                epochs=1):
+        args = batch_args(batch)
+        table, acc, loss_d, _ = step(table, acc, **args)
+        loss_p, _ = off_step(**args)
+        assert float(loss_d) == pytest.approx(float(loss_p), abs=1e-6)
+
+
+def test_pinned_big_init_layout(monkeypatch):
+    """The chunked at-scale init writes uniform rows over [0, vocab),
+    keeps the pad row and the ckpt-alignment tail zero, and never
+    exceeds init_value_range — checked by forcing the big path at a
+    small size."""
+    monkeypatch.setattr(HostOffloadLookup, "_DEVICE_INIT_MAX_ROWS", 64)
+    cfg = FmConfig(vocabulary_size=300, factor_num=4)
+    lk = PinnedHostLookup(cfg, seed=3)
+    t = np.asarray(lk.table)
+    assert t.shape == (cfg.ckpt_rows, cfg.row_dim)
+    live = t[:cfg.vocabulary_size]
+    assert np.abs(live).max() <= cfg.init_value_range
+    assert (live != 0).mean() > 0.99  # uniform rows actually written
+    np.testing.assert_array_equal(t[cfg.vocabulary_size:], 0.0)
+    a = np.asarray(lk.acc)
+    np.testing.assert_array_equal(a, np.float32(cfg.adagrad_init))
 
 
 def test_host_lookup_rejects_multiprocess(tmp_path, rng, monkeypatch):

@@ -4,15 +4,20 @@
 BASELINE config #5's shape is a 10^9-row hashed FM whose table lives
 outside device memory. This tool runs the same *structure* at a
 configurable scale (default 10^8 rows ~= 3.6 GB table + 3.6 GB Adagrad
-accumulator in host RAM, vs ~16 GB device HBM on a v5 lite chip, most of
-it untouched): synthesizes hashed-id libsvm data, trains steps through
-the lookup.py host backend on the real chip, and prints a JSON
-accounting line proving the table stayed host-side —
+accumulator, vs ~16 GB device HBM on a v5 lite chip): synthesizes
+hashed-id libsvm data, trains steps through the lookup.py offload seam
+on the real chip, and prints a JSON accounting line proving where the
+state lived —
 
-    host_rss_mb   ~ table + accumulator (+ interpreter)
-    device_in_use_mb  stays at the [U, D] gathered-rows scale
+- ``numpy`` backend: local host RSS covers table + accumulator; the
+  device only ever holds the per-batch [U, D] blocks.
+- ``pinned`` backend (the device-resident fast path): the state's jax
+  shardings report ``memory_kind="pinned_host"`` (accelerator-host
+  memory, NOT HBM, NOT local RAM — local RSS stays flat), and the whole
+  step runs in-jit with no per-step Python round-trip.
 
 Usage: python tools/offload_smoke.py [--rows 100000000] [--steps 20]
+       [--backend auto|pinned|numpy]
 The result is recorded in BASELINE.md (config #5 row).
 """
 
@@ -51,11 +56,16 @@ def main():
     ap.add_argument("--rows", type=int, default=100_000_000)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--backend", choices=("auto", "pinned", "numpy"),
+                    default="auto")
     args = ap.parse_args()
 
     from fast_tffm_tpu.config import FmConfig
-    from fast_tffm_tpu.lookup import HostOffloadLookup, memory_report
-    from fast_tffm_tpu.models.fm import ModelSpec, batch_args, make_grad_fn
+    from fast_tffm_tpu.lookup import (HostOffloadLookup, PinnedHostLookup,
+                                      make_offload_backend,
+                                      make_offload_train_step,
+                                      memory_report)
+    from fast_tffm_tpu.models.fm import ModelSpec, batch_args
     from fast_tffm_tpu.data.pipeline import batch_iterator
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -72,30 +82,35 @@ def main():
         spec = ModelSpec.from_config(cfg)
 
         t0 = time.perf_counter()
-        lk = HostOffloadLookup(cfg, seed=0)
+        if args.backend == "pinned":
+            lk = PinnedHostLookup(cfg, seed=0)
+        elif args.backend == "numpy":
+            lk = HostOffloadLookup(cfg, seed=0)
+        else:
+            lk = make_offload_backend(cfg, seed=0)
         init_s = time.perf_counter() - t0
         after_init = memory_report()
 
-        grad_fn = make_grad_fn(spec)
+        import jax
+        step = make_offload_train_step(spec, lk, cfg.learning_rate)
         n_steps = 0
         n_examples = 0
         loss = None
         t0 = time.perf_counter()
         for batch in batch_iterator(cfg, cfg.train_files, training=True,
                                     epochs=1):
-            a = batch_args(batch)
-            gathered = lk.gather(a["uniq_ids"])
-            loss, _, grad = grad_fn(gathered, **a)
-            lk.apply_grad(a["uniq_ids"], np.asarray(grad),
-                          cfg.learning_rate)
+            loss, _ = step(**batch_args(batch))
             n_steps += 1
             n_examples += batch.num_real
+        jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
 
-        import jax
         rep = memory_report()
         table_gb = lk.rows * lk.dim * 4 / 2**30
-        print(json.dumps({
+        pinned = isinstance(lk, PinnedHostLookup)
+        out = {
+            "backend": type(lk).__name__,
+            "mode": getattr(lk, "mode", "numpy"),
             "rows": lk.rows, "row_dim": lk.dim,
             "table_gb": round(table_gb, 2),
             "state_gb": round(2 * table_gb, 2),
@@ -107,12 +122,25 @@ def main():
             "host_rss_mb": rep["host_rss_mb"],
             "device_in_use_mb": rep.get("device_in_use_mb"),
             "device_limit_mb": rep.get("device_limit_mb"),
-            "backend": jax.default_backend(),
-        }))
-        # The accounting claim: host RSS covers the 2x-table state, the
-        # device holds ~nothing of it.
+            "platform": jax.default_backend(),
+        }
+        if pinned:
+            out["table_memory_kind"] = lk.table.sharding.memory_kind
+            out["acc_memory_kind"] = lk.acc.sharding.memory_kind
+        print(json.dumps(out))
+
+        # The accounting claims, per backend:
         dev = rep.get("device_in_use_mb")
-        assert rep["host_rss_mb"] > 2 * table_gb * 1024 * 0.9, rep
+        if pinned and lk.mode == "pinned":
+            # State in accelerator-host memory: the shardings say so,
+            # and LOCAL host RSS must NOT contain a 2x-table copy.
+            assert out["table_memory_kind"] == "pinned_host", out
+            assert out["acc_memory_kind"] == "pinned_host", out
+            assert rep["host_rss_mb"] < 2 * table_gb * 1024 * 0.5 + 4096, \
+                f"state appears to live in LOCAL RAM: {rep}"
+        elif not pinned:
+            # numpy backend: local host RSS covers the 2x-table state.
+            assert rep["host_rss_mb"] > 2 * table_gb * 1024 * 0.9, rep
         if dev is not None:
             assert dev < 1024, f"table leaked onto the device: {rep}"
 
