@@ -251,10 +251,21 @@ def global_batch(mesh: Mesh, local_uniq_size: int, **arrays) -> dict:
             continue
         arr = np.asarray(arr)
         if name == "local_idx":
-            arr = arr + np.int32(p * local_uniq_size)
+            arr = offset_local_idx(arr, p, local_uniq_size)
         sh = vec if arr.ndim == 1 else mat
         out[name] = jax.make_array_from_process_local_data(sh, arr)
     return out
+
+
+def offset_local_idx(local_idx: np.ndarray, process_index: int,
+                     local_uniq_size: int) -> np.ndarray:
+    """The multi-process unique-axis index math, factored out of
+    global_batch so the driver's dryrun can simulate P logical processes'
+    assembly through the REAL function (this offset is where the
+    index bugs would live): process p's local_idx values index its own
+    unique block, shifted into the concatenated global unique axis."""
+    return np.asarray(local_idx) + np.int32(process_index
+                                            * local_uniq_size)
 
 
 def local_rows(global_arr: jax.Array) -> np.ndarray:
