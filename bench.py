@@ -51,6 +51,11 @@ import numpy as np
 
 NORTH_STAR_PER_CHIP = 1e9 / 3600.0 / 64.0  # examples/sec/chip
 
+
+def _cparser_threads() -> int:
+    from fast_tffm_tpu.data import cparser
+    return cparser.auto_threads()
+
 B = 8192
 N_WARM, N_TIMED = 4, 40
 TRIALS = 3
@@ -287,9 +292,11 @@ def main():
         "unit": "examples/sec",
         "vs_baseline": round(eps / NORTH_STAR_PER_CHIP, 3),
         "e2e_trials": [round(v, 1) for v in e2e],
-        # BatchBuilder feed parse threads (auto: min(8, cores)); >1 means
-        # the host_only ceiling reflects the threaded builder.
-        "host_threads": min(8, os.cpu_count() or 1),
+        # BatchBuilder feed parse threads, read from the C++ library (1
+        # when the extension is unavailable and the generic Python path
+        # runs); >1 means the host_only ceiling reflects the threaded
+        # builder.
+        "host_threads": _cparser_threads(),
         "host_only": round(host, 1),
         "device_only": round(dev, 1),
         "h2d_only": round(h2d, 1),

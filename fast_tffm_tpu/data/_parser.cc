@@ -433,6 +433,14 @@ extern "C" {
 // feed: parallel parse into a pending queue + serial drain).
 int64_t fm_abi_version() { return 5; }
 
+// The auto ("num_threads = 0") parse-thread count, exported so Python
+// reports the value this library actually uses instead of re-deriving
+// the formula (which would drift silently).
+int fm_auto_threads() {
+  int T = int(std::min(8u, std::thread::hardware_concurrency()));
+  return T < 1 ? 1 : T;
+}
+
 // Returns 0 on success. Outputs:
 //   labels[n_examples], poses[n_examples+1], ids[nnz], vals[nnz]
 //   (+ fields[nnz] when field_aware — FFM `field:fid[:val]` tokens)
@@ -450,9 +458,7 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
     std::snprintf(err_out, size_t(err_cap), "vocabulary_size must be > 0");
     return 1;
   }
-  int T = num_threads > 0
-              ? num_threads
-              : int(std::min(8u, std::thread::hardware_concurrency()));
+  int T = num_threads > 0 ? num_threads : fm_auto_threads();
   if (T < 1) T = 1;
   if (blob_len < (64 << 10)) T = 1;  // small blocks: threading overhead
 
@@ -766,9 +772,7 @@ void* fm_bb_new(int64_t B, int64_t L, int64_t vocab, int hash_ids,
   // Thread count for the feed parse phase (0 = auto). T == 1 keeps the
   // original single-pass loop — on a 1-core host the phase-split would
   // only add buffer traffic.
-  int T = num_threads > 0
-              ? num_threads
-              : int(std::min(8u, std::thread::hardware_concurrency()));
+  const int T = num_threads > 0 ? num_threads : fm_auto_threads();
   bb->T = T < 1 ? 1 : T;
   bb->labels.resize(size_t(B));
   bb->uniq.resize(size_t(B * L + 1));

@@ -63,6 +63,7 @@ def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
     lib = ctypes.CDLL(path or _SO)
     try:
         lib.fm_abi_version
+        lib.fm_auto_threads
         lib.fm_parse_block
         lib.fm_dedup_ids
         lib.fm_bb_new
@@ -122,6 +123,8 @@ def _load() -> ctypes.CDLL:
                 subprocess.CalledProcessError, RuntimeError) as e:
             _load_error = f"C++ parser unavailable: {e}"
             raise RuntimeError(_load_error)
+        lib.fm_auto_threads.restype = ctypes.c_int
+        lib.fm_auto_threads.argtypes = []
         lib.fm_parse_block.restype = ctypes.c_int
         lib.fm_parse_block.argtypes = [
             ctypes.c_char_p, ctypes.c_int64,              # buffer, length
@@ -177,6 +180,17 @@ def available() -> bool:
         return True
     except RuntimeError:
         return False
+
+
+def auto_threads() -> int:
+    """The parse-thread count a ``num_threads=0`` builder actually uses
+    — read from the library (fm_auto_threads) so reporting can't drift
+    from the C++ rule. 1 when the extension is unavailable (the generic
+    Python path is single-threaded)."""
+    try:
+        return int(_load().fm_auto_threads())
+    except RuntimeError:
+        return 1
 
 
 def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,

@@ -720,20 +720,28 @@ def prefetch(iterator: Iterator[DeviceBatch],
 
     The reference overlaps input with compute via TF queue-runner threads
     (SURVEY §2 "Input pipeline"); here one host thread prepares the next
-    batches while the device runs the current step. The C++ parser and
-    numpy release the GIL, so the overlap is real — given a spare core.
+    batches while the device runs the current step. The C++ parser,
+    numpy, and the device-transfer waits all release the GIL, so the
+    overlap is real even on a single-core host: the builder thread runs
+    while the consumer waits on H2D (measured on the 1-core tunnelled
+    chip, round 4: threaded 825-857k ex/s vs serial 447-790k at bench
+    shapes, and never slower across dedup modes).
 
-    On a single-core host this is pure loss (measured 4x slower: the
-    worker thread contends with jax dispatch for the one core, and the
-    serial loop already overlaps device compute because dispatch is
-    async), so it degrades to a passthrough there.
+    The one configuration where the thread still loses is a single core
+    feeding the GIL-holding pure-PYTHON parser (no C++ extension —
+    measured 4x slower in round 2, when that was the only parser): the
+    worker then contends with jax dispatch for the core, so that case
+    keeps the passthrough. (Residual gap: weight_files force the Python
+    path even with the extension present; niche enough that the
+    availability check stands in for full path knowledge.)
     """
     import os
+    from fast_tffm_tpu.data import cparser
     try:
         n_cpus = len(os.sched_getaffinity(0))  # cgroup/cpuset-aware
     except AttributeError:
         n_cpus = os.cpu_count() or 1
-    if n_cpus <= 1:
+    if n_cpus <= 1 and not cparser.available():
         yield from iterator
         return
 
