@@ -349,31 +349,66 @@ def _iter_range_lines(path: str, start: int, end: int) -> Iterator[str]:
         yield tail.decode("utf-8")
 
 
+def _owned_start_line_index(path: str, start: int) -> int:
+    """Global line index of the first line OWNED by a byte range
+    beginning at ``start`` (ownership rules of _iter_owned_chunks) == the
+    newline count in [0, s) where s is that line's byte offset. A pure
+    memchr-speed scan (~GB/s) — it aligns line-parallel sidecar files
+    (weight_files) with a byte-range data shard without parsing."""
+    if start <= 0:
+        return 0
+    n = 0
+    with open(path, "rb") as fh:
+        # Newlines strictly before `start - 1`, then resolve the
+        # boundary: the newline at/after start-1 terminates the previous
+        # owner's line, so the first owned line is one past it.
+        remaining = start - 1
+        while remaining > 0:
+            b = fh.read(min(4 << 20, remaining))
+            if not b:
+                return n
+            n += b.count(b"\n")
+            remaining -= len(b)
+        while True:
+            b = fh.read(4 << 20)
+            if not b:
+                return n  # EOF before a newline: range owns nothing more
+            i = b.find(b"\n")
+            if i >= 0:
+                return n + 1
+            # keep scanning: the straddling line continues
+
+
 def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                 shard_index: int, num_shards: int,
                 keep_empty: bool = False) -> Iterator[Tuple[str, float]]:
     """Yield (line, weight) pairs for this shard.
 
-    Default sharding is per-file byte ranges (shard_byte_range): each
-    worker reads only its ~1/N of the bytes. Weight files are
-    line-parallel to data files, so byte-ranging the data would
-    misalign them — with weight_files the iterator falls back to
-    index-modulo sharding over a full read (weight files are a niche
-    reference feature; the fast path never has them)."""
+    Sharding is per-file byte ranges (shard_byte_range): each worker
+    PARSES only its ~1/N of the bytes. Weight files are line-parallel to
+    data files, so the weighted path aligns them by counting the data
+    shard's starting line index (_owned_start_line_index — a newline
+    scan, not a parse) and skipping that many weight lines; weight files
+    are ~20x smaller than their data, so each worker streaming its own
+    prefix of the weight file is cheap. (Until round 4 this path
+    index-modulo-sharded over a FULL read of the data — N workers each
+    reading and parsing every byte.)"""
     if weight_files:
         if len(weight_files) != len(files):
             raise ValueError("weight_files must parallel train_files "
                              f"({len(weight_files)} vs {len(files)})")
-        idx = 0
         for path, wpath in zip(files, weight_files):
-            with open(path) as fh, open(wpath) as wfh:
-                for line in fh:
+            start, end = shard_byte_range(path, shard_index, num_shards)
+            n_skip = _owned_start_line_index(path, start)
+            with open(wpath) as wfh:
+                for _ in range(n_skip):
+                    if not wfh.readline():
+                        break
+                for line in _iter_range_lines(path, start, end):
                     wline = wfh.readline()
                     if not line.strip(WHITESPACE) and not keep_empty:
                         continue
-                    if idx % num_shards == shard_index:
-                        yield line, float(wline) if wline.strip() else 1.0
-                    idx += 1
+                    yield line, float(wline) if wline.strip() else 1.0
         return
     for path in files:
         start, end = shard_byte_range(path, shard_index, num_shards)
@@ -714,8 +749,24 @@ def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None,
                              uniq_bucket=uniq_bucket or cfg.uniq_bucket)
 
 
-def prefetch(iterator: Iterator[DeviceBatch],
-             depth: int = 2) -> Iterator[DeviceBatch]:
+def gil_bound_iteration(cfg: FmConfig, weight_files: Sequence[str] = (),
+                        keep_empty: bool = False) -> bool:
+    """Whether batch_iterator's parsing for these inputs holds the GIL
+    (pure-Python parser) — the SAME path selection batch_iterator makes,
+    exposed so prefetch callers can gate the worker thread on it. Python
+    parsing happens when the C++ extension is unavailable, or on the
+    generic path's one parse=None case (keep_empty without the fast
+    path). The generic weighted path block-parses via the C++
+    parse_lines_fast, which releases the GIL."""
+    from fast_tffm_tpu.data import cparser
+    if not cparser.available():
+        return True
+    fast = not weight_files and cfg.max_features_per_example > 0
+    return (not fast) and keep_empty
+
+
+def prefetch(iterator: Iterator[DeviceBatch], depth: int = 2,
+             gil_bound: bool = False) -> Iterator[DeviceBatch]:
     """Run ``iterator`` in a background thread, ``depth`` batches ahead.
 
     The reference overlaps input with compute via TF queue-runner threads
@@ -727,23 +778,20 @@ def prefetch(iterator: Iterator[DeviceBatch],
     chip, round 4: threaded 825-857k ex/s vs serial 447-790k at bench
     shapes, and never slower across dedup modes).
 
-    The one configuration where the thread still loses is a single core
-    feeding the GIL-holding pure-PYTHON parser (no C++ extension —
-    measured 4x slower in round 2, when that was the only parser): the
-    worker then contends with jax dispatch for the core, so that case
-    keeps the passthrough. (Residual gap: weight_files force the Python
-    path even with the extension present; niche enough that the
-    availability check stands in for full path knowledge.)
+    ``gil_bound`` (see gil_bound_iteration): the iterator parses in pure
+    Python and would CONTEND with jax dispatch on a single core
+    (measured 4x slower in round 2, when Python was the only parser) —
+    that combination keeps the passthrough.
     """
-    import os
-    from fast_tffm_tpu.data import cparser
-    try:
-        n_cpus = len(os.sched_getaffinity(0))  # cgroup/cpuset-aware
-    except AttributeError:
-        n_cpus = os.cpu_count() or 1
-    if n_cpus <= 1 and not cparser.available():
-        yield from iterator
-        return
+    if gil_bound:
+        import os
+        try:
+            n_cpus = len(os.sched_getaffinity(0))  # cgroup/cpuset-aware
+        except AttributeError:
+            n_cpus = os.cpu_count() or 1
+        if n_cpus <= 1:
+            yield from iterator
+            return
 
     import queue
     import threading

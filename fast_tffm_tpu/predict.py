@@ -18,7 +18,7 @@ import numpy as np
 from fast_tffm_tpu.checkpoint import CheckpointState
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
-                                         prefetch)
+                                         gil_bound_iteration, prefetch)
 from fast_tffm_tpu.metrics import sigmoid
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
                                      make_batch_scorer, ships_raw_batches)
@@ -70,7 +70,9 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1, keep_empty=True,
                                          raw_ids=raw),
-                          depth=cfg.prefetch_depth):
+                          depth=cfg.prefetch_depth,
+                          gil_bound=gil_bound_iteration(
+                              cfg, keep_empty=True)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         fetcher.add(score_fn(table, args), batch.num_real)

@@ -23,7 +23,8 @@ import numpy as np
 from fast_tffm_tpu.checkpoint import CheckpointState, export_npz
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.pipeline import (SPILL_WARN_FRACTION, SpillStats,
-                                         batch_iterator, prefetch,
+                                         batch_iterator,
+                                         gil_bound_iteration, prefetch,
                                          uniq_bucket_top)
 from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
@@ -66,7 +67,8 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
         lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]]))
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1, raw_ids=raw),
-                          depth=cfg.prefetch_depth):
+                          depth=cfg.prefetch_depth,
+                          gil_bound=gil_bound_iteration(cfg)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         fetcher.add(score_fn(table, args), (batch.labels, batch.num_real))
@@ -370,7 +372,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
                 fixed_shape=multi_process, uniq_bucket=uniq_bucket,
                 stats=epoch_stats, raw_ids=raw_mode),
-                depth=cfg.prefetch_depth)
+                depth=cfg.prefetch_depth,
+                gil_bound=gil_bound_iteration(cfg, cfg.weight_files))
             while True:
                 batch = next(it, None)
                 if multi_process:

@@ -196,3 +196,35 @@ def test_config_validates_uniq_bucket():
     with pytest.raises(ValueError, match="max_features_per_example"):
         FmConfig(uniq_bucket=128, max_features_per_example=256)
     FmConfig(uniq_bucket=128, max_features_per_example=64)  # ok
+
+
+def test_weighted_byte_range_partition(tmp_path):
+    """Weight-files input shards by byte range like the unweighted path
+    (round 4; previously index-modulo over a FULL read — N workers each
+    reading every byte): every (line, weight) pair lands in exactly one
+    shard, correctly paired across blank data lines and shard
+    boundaries, and concatenation preserves order."""
+    data = tmp_path / "d.txt"
+    wts = tmp_path / "w.txt"
+    lines, weights = [], []
+    rng = np.random.default_rng(5)
+    for i in range(97):
+        if i % 13 == 7:
+            lines.append("")           # blank: skipped, consumes a weight
+        else:
+            lines.append(f"1 {i}:1")
+        weights.append(round(float(rng.random()) + 0.5, 3))
+    data.write_text("\n".join(lines) + "\n")
+    wts.write_text("\n".join(str(w) for w in weights) + "\n")
+
+    expected = [(ln, w) for ln, w in zip(lines, weights) if ln]
+    for num_shards in (1, 2, 3, 5):
+        got = []
+        for i in range(num_shards):
+            got.extend(
+                (line.rstrip("\n"), w)
+                for line, w in _iter_lines([str(data)], [str(wts)],
+                                           i, num_shards))
+        assert [g[0] for g in got] == [e[0] for e in expected], num_shards
+        assert [g[1] for g in got] == pytest.approx(
+            [e[1] for e in expected]), num_shards
