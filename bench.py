@@ -104,14 +104,15 @@ def run_e2e(cfg, step, n_warm=N_WARM):
     spec, like train() does). One timing protocol for every e2e line
     (FM headline and FFM)."""
     import jax
-    from fast_tffm_tpu.data.pipeline import batch_iterator, prefetch
+    from fast_tffm_tpu.data.pipeline import (batch_iterator,
+                                             gil_bound_iteration, prefetch)
     from fast_tffm_tpu.models.fm import (batch_args, init_accumulator,
                                          init_table)
     table = init_table(cfg, 0)
     acc = init_accumulator(cfg)
     it = prefetch(batch_iterator(cfg, cfg.train_files, training=True,
                                  raw_ids=_raw_mode(cfg)),
-                  depth=4)
+                  depth=4, gil_bound=gil_bound_iteration(cfg))
     t0 = None
     n = 0
     n_real = 0  # real examples in the timed span (short final batch counts

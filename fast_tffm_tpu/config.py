@@ -75,10 +75,11 @@ class FmConfig:
     adagrad_init: float = 0.1       # TF Adagrad accumulator init default
     save_steps: int = 0             # 0 = save only at end
     log_steps: int = 100
-    # Reference knob (SURVEY Appendix A [L]): TF1 summary-writer cadence.
-    # Accepted so a verbatim reference cfg loads; no TF summaries exist
-    # here (step/loss logging is log_steps, profiling is profile_dir), so
-    # load_config warns when it is set. 0 = unset.
+    # Reference knob (SURVEY Appendix A [L]): summary-writer cadence.
+    # > 0 writes TensorBoard scalars (train loss, examples/sec,
+    # validation AUC) every this many steps to <model_file>.tb/
+    # (utils/summaries.py; buffered and flushed at epoch barriers so the
+    # cadence never adds mid-stream device fetches). 0 = off.
     save_summaries_steps: int = 0
     # Cap per-epoch validation at this many batches PER INPUT SHARD
     # (process) — 0 = full sweep. At Criteo-1TB scale an every-epoch
@@ -315,10 +316,4 @@ def load_config(path: str) -> FmConfig:
             "for compatibility but has no effect: the reference used it to "
             "partition the table across parameter servers; here the device "
             "mesh decides row sharding (parallel/sharded.py)")
-    if cfg.save_summaries_steps:
-        warnings.warn(
-            f"save_summaries_steps = {cfg.save_summaries_steps} is accepted "
-            "for compatibility but has no effect: there are no TF1 "
-            "summaries here; use log_steps for step/loss cadence and "
-            "profile_dir for traces")
     return cfg

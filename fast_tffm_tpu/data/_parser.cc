@@ -74,8 +74,22 @@ struct ShardOut {
   std::string error;
 };
 
+// Byte class table for the separator test: one L1-resident load beats
+// the 5-way compare chain in the token-scan loops (measured 1.4x on a
+// scan-only microbench; the full-parse effect is a few percent, inside
+// this environment's ambient noise — kept because the scan loops are
+// the host throughput ceiling and the semantics are byte-identical).
+// Set bytes: \t \v \f \r and space. parser.WHITESPACE is this set PLUS
+// \n (Python strips whole decoded lines); here \n must stay 0 — the
+// C++ paths split on it as the LINE terminator first, and marking it a
+// token separator would silently merge lines.
+static const uint8_t kWsTable[256] = {
+    0, 0, 0, 0, 0, 0, 0, 0, 0, 1 /*\t*/, 0 /*\n*/, 1 /*\v*/, 1 /*\f*/,
+    1 /*\r*/, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+    1 /*space*/};
+
 inline bool is_ws(char c) {
-  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+  return kWsTable[static_cast<unsigned char>(c)] != 0;
 }
 
 // Slow-path float parse via strtod + float cast. Double-then-float

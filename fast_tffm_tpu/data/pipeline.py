@@ -23,6 +23,7 @@ Padding invariants (relied on by ops/ and tests):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import glob as globlib
 import os
 import random
@@ -349,12 +350,18 @@ def _iter_range_lines(path: str, start: int, end: int) -> Iterator[str]:
         yield tail.decode("utf-8")
 
 
+@functools.lru_cache(maxsize=512)
 def _owned_start_line_index(path: str, start: int) -> int:
     """Global line index of the first line OWNED by a byte range
     beginning at ``start`` (ownership rules of _iter_owned_chunks) == the
     newline count in [0, s) where s is that line's byte offset. A pure
     memchr-speed scan (~GB/s) — it aligns line-parallel sidecar files
-    (weight_files) with a byte-range data shard without parsing."""
+    (weight_files) with a byte-range data shard without parsing.
+
+    Memoized: train() builds a fresh iterator per epoch, and this value
+    is constant per (path, start) given the byte-range sharding's
+    standing assumption that input files don't change mid-run
+    (shard_byte_range re-reads only the size)."""
     if start <= 0:
         return 0
     n = 0

@@ -289,6 +289,18 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     stopping = False
     last_val = None  # (auc, n) of the most recent validation pass
 
+    # TensorBoard scalars (save_summaries_steps; utils/summaries.py).
+    # Chief-only, and flushed ONLY at epoch barriers: values buffer as
+    # device scalars so the cadence adds zero mid-stream fetches.
+    summaries = None
+    if cfg.save_summaries_steps and jax.process_index() == 0:
+        from fast_tffm_tpu.utils.summaries import make_summaries
+        summaries = make_summaries(cfg)
+        if summaries is not None:
+            logger.info("writing TensorBoard summaries every %d steps "
+                        "to %s", cfg.save_summaries_steps,
+                        summaries.logdir)
+
     # Adaptive loss logging. float(loss) is a synchronous device->host
     # fetch; on direct-attached devices it costs microseconds, but over
     # a proxied/tunnelled device link ANY mid-stream fetch stalls the
@@ -425,6 +437,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 if cfg.log_steps and global_step % cfg.log_steps == 0:
                     log_tick(global_step, epoch, loss,
                              timer.examples_per_sec)
+                if (summaries is not None and global_step
+                        % cfg.save_summaries_steps == 0):
+                    summaries.add("train/loss", global_step, loss)
+                    summaries.add("train/examples_per_sec", global_step,
+                                  timer.examples_per_sec)
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
                     state = (lk.state() if offload
                              else ckpt_state(cfg, table, acc))
@@ -479,6 +496,10 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     logger.info(
                         "epoch %d validation AUC %.6f over %d examples",
                         epoch, auc, n)
+                if summaries is not None:
+                    summaries.add("validation/auc", global_step, auc)
+            if summaries is not None:  # epoch barrier: bulk-fetch + write
+                summaries.flush()
         flush_log()
         loss_val = float(loss) if loss is not None else loss_val
         state = lk.state() if offload else ckpt_state(cfg, table, acc)
@@ -506,6 +527,13 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                        vocabulary_size=cfg.vocabulary_size)
     finally:
         try:
+            if summaries is not None:
+                # Buffered scalars must reach the event file even when
+                # the loop raised or a preemption cut the final epoch.
+                try:
+                    summaries.close()
+                except Exception:
+                    logger.exception("summary writer close failed")
             if profiling:
                 # Window ran past the end of training — or the loop
                 # raised with the window open; either way the trace must

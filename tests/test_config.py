@@ -57,7 +57,9 @@ def test_appendix_a_cfg_loads_verbatim(tmp_path):
     """SURVEY Appendix A's reconstructed sample.cfg — every key,
     including the [L]-tier ones (weight_files, validation_files,
     save_summaries_steps) — loads without error; no-op reference knobs
-    warn instead of raising (VERDICT r3 missing #3)."""
+    warn instead of raising (VERDICT r3 missing #3).
+    save_summaries_steps is a REAL knob now (utils/summaries.py), so it
+    loads silently."""
     path = write_cfg(tmp_path, """
         [General]
         vocabulary_size = 80000000
@@ -94,7 +96,6 @@ def test_appendix_a_cfg_loads_verbatim(tmp_path):
         cfg = load_config(path)
     msgs = [str(w.message) for w in rec]
     assert any("vocabulary_block_num" in m for m in msgs)
-    assert any("save_summaries_steps" in m for m in msgs)
     assert cfg.vocabulary_size == 80000000
     assert cfg.save_summaries_steps == 100
     assert cfg.weight_files == () and cfg.validation_files == ()
