@@ -357,3 +357,50 @@ worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
     auc_sp, auc_mp = auc_of(table_sp), auc_of(table_mp)
     assert auc_sp > 0.85, auc_sp
     assert abs(auc_sp - auc_mp) < 0.03, (auc_sp, auc_mp)
+
+
+@pytest.mark.slow
+def test_two_worker_adaptive_uniq_bucket(tmp_path):
+    """A dense id cluster the startup probe misses: epoch 1 spills above
+    the warn threshold, the epoch-boundary allgather agrees on a raise,
+    and BOTH workers double the bucket in lockstep (a process raising
+    alone would desynchronize global shapes and deadlock) — the
+    multi-process leg of train.adapt_uniq_bucket."""
+    lines = []
+    next_id = 1000
+    for i in range(2000):
+        if 900 <= i < 964:  # dense cluster: 20 fresh ids per line,
+            ids = range(next_id, next_id + 20)  # hidden from the probe's
+            next_id += 20                       # head/middle/tail windows
+            lines.append("1 " + " ".join(f"{j}:1" for j in ids))
+        else:
+            lines.append("0 0:1 1:1 2:1 3:1")
+    data = tmp_path / "train.txt"
+    data.write_text("\n".join(lines) + "\n")
+    coord = _free_port()
+    cfg = tmp_path / "dist.cfg"
+    cfg.write_text(f"""
+[General]
+vocabulary_size = 65536
+factor_num = 2
+model_file = {tmp_path / 'model' / 'fm'}
+
+[Train]
+train_files = {data}
+epoch_num = 3
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+log_steps = 0
+max_features_per_example = 32
+bucket_ladder = 32
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+    outs = _launch(cfg)
+    for i, out in enumerate(outs):
+        assert "fixed unique-row bucket: 64" in out, f"worker {i}"
+        assert "raising uniq_bucket 64 -> 128" in out, f"worker {i}"
+        assert "raising uniq_bucket 128 -> 256" in out, f"worker {i}"
+    assert any("training done" in o for o in outs)
