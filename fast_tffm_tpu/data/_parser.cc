@@ -140,21 +140,22 @@ bool parse_float_slow(const char* begin, const char* end, float* out) {
   return true;
 }
 
+// Exact powers of ten for the simple-decimal fast paths: mantissa /
+// 10^frac is one correctly-rounded double op (mantissa exact in 2^53,
+// powers exact up to 1e22), equal to Python's float(token).
+static const double kPow10[23] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10,
+    1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21,
+    1e22};
+
 // Parse one whitespace-delimited token as float; matches Python float()
 // -> float32 on all inputs. Returns false on garbage/empty.
 //
 // Fast path: plain decimals (the overwhelming case in libsvm data,
-// "1.374", "0.83", "1") with <= 15 digits and <= 22 fractional digits.
-// mantissa/10^frac is a single correctly-rounded double op (mantissa
-// exact in 2^53, power of ten exact up to 1e22), so it equals Python's
-// correctly-rounded float(token); the final float cast matches too.
-// strtod/strtof dominate parse time otherwise (~100ns/token, 40
-// tokens/line at Criteo shapes).
+// "1.374", "0.83", "1") with <= 15 digits and <= 22 fractional digits
+// (see kPow10). strtod/strtof dominate parse time otherwise
+// (~100ns/token, 40 tokens/line at Criteo shapes).
 inline bool parse_float(const char* begin, const char* end, float* out) {
-  static const double kPow10[23] = {
-      1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,  1e10,
-      1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18, 1e19, 1e20, 1e21,
-      1e22};
   if (begin == end) return false;
   const char* p = begin;
   bool neg = false;
@@ -229,6 +230,65 @@ struct Token {
   int32_t field;  // field-aware only
   float val;
 };
+
+// Single-pass fast path for the dominant token shape in non-hashed FM
+// data: `<int fid>[:<simple decimal>]`. Parses WHILE scanning — the
+// general path walks the token bytes twice (scan_token for structure,
+// then parse_int/parse_float over the same ranges), and this loop is
+// the host throughput ceiling. Returns 1 with (*tok_end_out, *t)
+// filled on success; 0 for ANYTHING unusual (sign, exponent, second
+// colon, out-of-range id, non-digit, overlong) — the caller then runs
+// the general scan+parse path, which owns all error semantics, so the
+// two paths cannot disagree on what's accepted (golden + property
+// tests pin that).
+inline int try_simple_fm_token(const char* q, const char* line_end,
+                               int64_t vocab, const char** tok_end_out,
+                               Token* t) {
+  const char* p = q;
+  uint64_t fid = 0;
+  int digs = 0;
+  while (p < line_end) {
+    const char c = *p;
+    if (c < '0' || c > '9') break;
+    fid = fid * 10 + uint64_t(c - '0');
+    if (fid && ++digs > 18) return 0;
+    p++;
+  }
+  if (p == q) return 0;  // no leading digits (sign, string id, ...)
+  if (fid >= uint64_t(vocab)) return 0;  // general path raises properly
+  if (p >= line_end || is_ws(*p)) {
+    t->val = 1.0f;
+  } else if (*p == ':') {
+    p++;
+    uint64_t mant = 0;
+    int vdigs = 0, frac = 0;
+    bool dot = false, any = false;
+    while (p < line_end) {
+      const char c = *p;
+      if (c >= '0' && c <= '9') {
+        any = true;
+        if (vdigs >= 15) return 0;
+        mant = mant * 10 + uint64_t(c - '0');
+        if (mant) vdigs++;
+        if (dot) frac++;
+      } else if (c == '.' && !dot) {
+        dot = true;
+      } else {
+        break;
+      }
+      p++;
+    }
+    if (p < line_end && !is_ws(*p)) return 0;  // exponent, ':', garbage
+    if (!any || frac > 22) return 0;
+    t->val = float(double(mant) / kPow10[frac]);
+  } else {
+    return 0;  // fid runs into non-digit, non-colon, non-ws bytes
+  }
+  t->row = int32_t(fid);
+  t->field = 0;
+  *tok_end_out = p;
+  return 1;
+}
 
 // Scan one whitespace-delimited token, recording its first two colons
 // and whether more exist — one pass shared with token-boundary
@@ -351,25 +411,32 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
     }
     out->labels.push_back(label);
     int32_t n_feats = 0;
+    const bool simple_ok = !hash_ids && !field_aware;
     q = tok_end;
     while (true) {
       while (q < line_end && is_ws(*q)) q++;
       if (q >= line_end) break;
-      const char* c1;
-      const char* c2;
-      bool extra;
-      tok_end = scan_token(q, line_end, &c1, &c2, &extra);
+      Token t;
       if (max_feats > 0 && n_feats >= max_feats) {
         // Python breaks out at the cap without validating the tail of
         // the line; skipping (not erroring) matches that.
-        q = tok_end;
+        const char* c1;
+        const char* c2;
+        bool extra;
+        q = scan_token(q, line_end, &c1, &c2, &extra);
         continue;
       }
-      Token t;
-      std::string err;
-      if (parse_token(q, tok_end, c1, c2, extra, vocab, hash_ids,
-                      field_aware, field_num, &t, &err)) {
-        return fail(out, lineno, err);
+      if (!(simple_ok
+            && try_simple_fm_token(q, line_end, vocab, &tok_end, &t))) {
+        const char* c1;
+        const char* c2;
+        bool extra;
+        tok_end = scan_token(q, line_end, &c1, &c2, &extra);
+        std::string err;
+        if (parse_token(q, tok_end, c1, c2, extra, vocab, hash_ids,
+                        field_aware, field_num, &t, &err)) {
+          return fail(out, lineno, err);
+        }
       }
       out->ids.push_back(t.row);
       out->vals.push_back(t.val);
@@ -852,25 +919,34 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
     int n_feats = 0;
     bb->line_slots.clear();
     const int32_t saved_uniq = bb->n_uniq;
+    const bool simple_ok = !bb->hash_ids && !bb->field_aware;
     q = tok_end;
     while (true) {
       while (q < line_end && is_ws(*q)) q++;
       if (q >= line_end) break;
-      const char* c1;
-      const char* c2;
-      bool extra;
-      tok_end = scan_token(q, line_end, &c1, &c2, &extra);
+      Token t;
       if (n_feats >= bb->max_feats) {  // cap: skip tail like Python
-        q = tok_end;
+        const char* c1;
+        const char* c2;
+        bool extra;
+        q = scan_token(q, line_end, &c1, &c2, &extra);
         continue;
       }
-      Token t;
-      std::string terr;
-      if (parse_token(q, tok_end, c1, c2, extra, bb->vocab, bb->hash_ids,
-                      bb->field_aware, bb->field_num, &t, &terr)) {
-        std::snprintf(err_out, size_t(err_cap), "line %lld: %s",
-                      (long long)bb->lineno, terr.c_str());
-        return -1;
+      if (!(simple_ok
+            && try_simple_fm_token(q, line_end, bb->vocab, &tok_end,
+                                   &t))) {
+        const char* c1;
+        const char* c2;
+        bool extra;
+        tok_end = scan_token(q, line_end, &c1, &c2, &extra);
+        std::string terr;
+        if (parse_token(q, tok_end, c1, c2, extra, bb->vocab,
+                        bb->hash_ids, bb->field_aware, bb->field_num, &t,
+                        &terr)) {
+          std::snprintf(err_out, size_t(err_cap), "line %lld: %s",
+                        (long long)bb->lineno, terr.c_str());
+          return -1;
+        }
       }
       irow[n_feats] = bb->raw_ids ? t.row : bb_slot(bb, t.row);
       vrow[n_feats] = t.val;
