@@ -30,6 +30,11 @@ between rounds, the same JSON carries the attribution breakdown:
 - ``order3_e2e``: end-to-end rate of the order-3 ANOVA-kernel FM
   (BASELINE config #4 shapes) — the higher-order capability's line.
 
+Every e2e line (headline, ffm, order3, k16) is the median of TRIALS
+runs with the per-trial values alongside: a single late-in-the-run
+trial can read 8x low on a tunnelled chip (measured), and the medians
+make that attributable instead of alarming.
+
 Whichever of host_only/device_only sits near the e2e number names the
 bottleneck; a regression that moves e2e but neither ceiling is noise.
 
@@ -44,6 +49,7 @@ the north-star rate.
 """
 
 import json
+import os
 import statistics
 import time
 
@@ -187,9 +193,12 @@ def synth_ffm_lines(n, vocab, field_num=24, seed=0):
 
 
 def run_ffm_e2e(tmp):
-    """One compact FFM end-to-end trial (config #3 shapes), same timing
-    protocol as the headline (run_e2e)."""
-    import os
+    """FFM end-to-end trials (config #3 shapes), same timing protocol as
+    the headline (run_e2e). Returns TRIALS rates: the first full bench
+    run showed a single late-in-the-run trial can read 8x low on this
+    tunnel (order3 138k in-run vs 880-938k re-run in isolation), so
+    every e2e line gets the headline's median-of-trials treatment —
+    post-compile trials cost ~0.4 s each."""
     from fast_tffm_tpu.config import FmConfig
     from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
     B_ffm, n_warm, n_timed = 4096, 3, 12
@@ -203,14 +212,13 @@ def run_ffm_e2e(tmp):
                    max_features_per_example=32, bucket_ladder=(32,),
                    train_files=(path,), shuffle=False)
     step = make_train_step(ModelSpec.from_config(cfg))
-    return run_e2e(cfg, step, n_warm=n_warm)
+    return [run_e2e(cfg, step, n_warm=n_warm) for _ in range(TRIALS)]
 
 
 def run_order3_e2e(tmp):
-    """One compact order-3 FM end-to-end trial (config #4 shapes), same
-    timing protocol as the headline (run_e2e). Reuses the FM data file
-    already in ``tmp``."""
-    import os
+    """Order-3 FM end-to-end trials (config #4 shapes), same timing
+    protocol and median-of-trials treatment as the headline (see
+    run_ffm_e2e on why). Reuses the FM data file already in ``tmp``."""
     from fast_tffm_tpu.config import FmConfig
     from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
     cfg = FmConfig(vocabulary_size=1 << 20, factor_num=8, order=3,
@@ -220,18 +228,19 @@ def run_order3_e2e(tmp):
                    train_files=(os.path.join(tmp, "train.txt"),),
                    shuffle=False)
     step = make_train_step(ModelSpec.from_config(cfg))
-    return run_e2e(cfg, step, n_warm=3)
+    return [run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS)]
 
 
 def run_k16(cfg16):
-    """BASELINE config #2's model shape (2nd-order FM, k=16): one e2e
-    trial plus the device-only Pallas-vs-XLA pair — the round-3 kernel
-    claim (2.9x at k=8) was never validated at this k (VERDICT r3 weak
-    #6). Reuses the headline data file via ``cfg16``."""
+    """BASELINE config #2's model shape (2nd-order FM, k=16): e2e trials
+    plus the device-only Pallas-vs-XLA pair — the round-3 kernel claim
+    (2.9x at k=8) was never validated at this k (VERDICT r3 weak #6).
+    Reuses the headline data file via ``cfg16``."""
     import dataclasses
     from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
     spec = ModelSpec.from_config(cfg16)
-    e2e = run_e2e(cfg16, make_train_step(spec), n_warm=3)
+    step = make_train_step(spec)
+    e2e = [run_e2e(cfg16, step, n_warm=3) for _ in range(TRIALS)]
     dev = {}
     for kern in ("pallas", "xla"):
         kspec = dataclasses.replace(spec, kernel=kern)
@@ -256,18 +265,121 @@ def run_h2d_only(cfg):
     return N_TIMED * B / (time.perf_counter() - t0)
 
 
+def _enable_compile_cache():
+    """Share the CLI's persistent XLA compile cache so the isolated
+    line subprocesses (and repeat bench invocations) skip recompiles.
+    Compile time is already excluded from every timed span by warmup;
+    the cache only shrinks bench wall-clock."""
+    from run_tffm import _enable_compilation_cache
+    _enable_compilation_cache()
+
+
+def _run_line(name, train_path):
+    """One secondary e2e line by name -> its result dict. The single
+    dispatch both the subprocess entry and the in-process fallback go
+    through, so they cannot drift apart."""
+    tmp = os.path.dirname(train_path)
+    if name == "ffm":
+        return {"trials": run_ffm_e2e(tmp)}
+    if name == "order3":
+        return {"trials": run_order3_e2e(tmp)}
+    if name == "k16":
+        import dataclasses
+        e2e, dev = run_k16(dataclasses.replace(make_cfg(train_path),
+                                               factor_num=16))
+        return {"trials": e2e, "device": dev}
+    raise SystemExit(f"unknown bench line {name!r}")
+
+
+def _line_main(name, train_path):
+    """Subprocess entry for one isolated e2e line: prints one JSON
+    object on stdout (see _isolated_line for why these run out of
+    process)."""
+    _enable_compile_cache()
+    print(json.dumps(_run_line(name, train_path)))
+
+
+# A line is ~1 min including compile (cache-cold); a child that takes
+# 10x that is wedged (the tunnelled runtime stalling is exactly the
+# flakiness that motivated isolation) and the parent must not hang
+# silently on it.
+LINE_TIMEOUT_S = 600
+
+
+def _isolated_line(name, train_path):
+    """Run one e2e line in a fresh process and return its JSON dict,
+    with ``isolation`` recording whether isolation actually happened.
+
+    Measured on this tunnelled chip (2026-07-30): an e2e line that
+    sustains 0.9-1.2M examples/sec in a fresh process reads as low as
+    118k when it runs AFTER other compiled programs in the same
+    process — same-program repetition is stable (order3 x9: 830-926k),
+    but mixing programs degrades every later line, and all TRIALS of a
+    late line read low together, so medians alone cannot repair it.
+    Local state is clean when it happens (no leaked threads,
+    jax.live_arrays() empty), pointing at the remote device runtime;
+    process isolation is the level that provably restores the rate.
+    Failure handling never runs foreign programs before the headline:
+    a subprocess that fails to spawn or crashes is marked ``isolation:
+    "failed"`` and main() reruns it in-process only AFTER its own
+    measurements (so the fallback's compiled programs cannot
+    contaminate the headline; the rerun is then marked
+    ``"in-process"`` — the caveat the number must carry). A child that
+    WEDGES (timeout) is different again: the stall is the device
+    runtime, so any rerun could hang the parent unbounded — that line
+    stays null (``isolation: "timeout"``) and the rest of the artifact
+    survives."""
+    import subprocess
+    import sys
+    detail = ""
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--line", name,
+             train_path],
+            capture_output=True, text=True, timeout=LINE_TIMEOUT_S)
+        if res.returncode == 0:
+            try:
+                out = json.loads(res.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                out = None
+            if isinstance(out, dict):
+                out["isolation"] = "subprocess"
+                return out
+            detail = f"unparseable stdout: {res.stdout[-200:]!r}"
+        else:
+            detail = (f"rc={res.returncode}, stderr tail: "
+                      f"{res.stderr[-500:]}")
+    except subprocess.TimeoutExpired:
+        print(f"bench line {name}: subprocess wedged for "
+              f"{LINE_TIMEOUT_S}s (stalled device runtime?); recording "
+              f"null rather than risking a hung rerun", file=sys.stderr)
+        return {"trials": None, "device": None, "isolation": "timeout"}
+    print(f"bench line {name}: subprocess failed ({detail}); will rerun "
+          f"in-process after the headline measurements", file=sys.stderr)
+    return {"trials": None, "device": None, "isolation": "failed"}
+
+
 def main():
-    import os
     import tempfile
 
     from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
 
+    _enable_compile_cache()
     with tempfile.TemporaryDirectory() as tmp:
         path = os.path.join(tmp, "train.txt")
         lines = synth_lines((N_WARM + N_TIMED) * B, 1 << 20)
         with open(path, "w") as fh:
             fh.write("\n".join(lines) + "\n")
         del lines
+
+        # The isolated lines run FIRST, before this process touches the
+        # device: on runtimes with exclusive per-process TPU locking a
+        # child could not initialize while the parent holds the chip
+        # (this tunnel multiplexes, but the artifact must not depend on
+        # that), and nothing below needs to have run before them.
+        ffm_res = _isolated_line("ffm", path)
+        order3_res = _isolated_line("order3", path)
+        k16_res = _isolated_line("k16", path)
 
         cfg = make_cfg(path)
         spec = ModelSpec.from_config(cfg)
@@ -281,10 +393,21 @@ def main():
         # (what each process's pipeline sustains in multi-process mode).
         shard = run_host_only(cfg, shard_index=0, num_shards=2,
                               raw_ids=False)
-        ffm = run_ffm_e2e(tmp)
-        order3 = run_order3_e2e(tmp)
-        import dataclasses
-        k16, k16_dev = run_k16(dataclasses.replace(cfg, factor_num=16))
+
+        # Deferred in-process fallbacks for failed (not wedged) line
+        # subprocesses — AFTER the parent's own measurements, so a
+        # fallback's compiled programs cannot contaminate the headline
+        # (see _isolated_line).
+        for name, res in (("ffm", ffm_res), ("order3", order3_res),
+                          ("k16", k16_res)):
+            if res["isolation"] == "failed":
+                res.update(_run_line(name, path))
+                res["isolation"] = "in-process"
+        ffm, order3 = ffm_res["trials"], order3_res["trials"]
+        k16, k16_dev = k16_res["trials"], k16_res["device"]
+
+    def med(trials):  # None survives a timed-out line (see _isolated_line)
+        return round(statistics.median(trials), 1) if trials else None
 
     eps = statistics.median(e2e)
     print(json.dumps({
@@ -302,13 +425,31 @@ def main():
         "device_only": round(dev, 1),
         "h2d_only": round(h2d, 1),
         "sharded_input_per_worker": round(shard, 1),
-        "ffm_e2e": round(ffm, 1),
-        "order3_e2e": round(order3, 1),
-        "k16_e2e": round(k16, 1),
-        "k16_device_pallas": round(k16_dev["pallas"], 1),
-        "k16_device_xla": round(k16_dev["xla"], 1),
+        "ffm_e2e": med(ffm),
+        "ffm_e2e_trials": [round(v, 1) for v in ffm] if ffm else None,
+        "order3_e2e": med(order3),
+        "order3_e2e_trials":
+            [round(v, 1) for v in order3] if order3 else None,
+        "k16_e2e": med(k16),
+        "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
+        "k16_device_pallas": round(k16_dev["pallas"], 1) if k16_dev
+        else None,
+        "k16_device_xla": round(k16_dev["xla"], 1) if k16_dev else None,
+        # Whether each of ffm/order3/k16 actually ran in a fresh process
+        # (see _isolated_line on the measured in-process cross-program
+        # degradation); "in-process" marks a fallback whose number
+        # carries that caveat.
+        "line_isolation": {"ffm": ffm_res["isolation"],
+                           "order3": order3_res["isolation"],
+                           "k16": k16_res["isolation"]},
     }))
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if len(sys.argv) > 1 and sys.argv[1] == "--line":
+        if len(sys.argv) != 4:
+            raise SystemExit("usage: bench.py --line <name> <train_path>")
+        _line_main(sys.argv[2], sys.argv[3])
+    else:
+        main()

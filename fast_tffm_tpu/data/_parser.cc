@@ -419,11 +419,9 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
       Token t;
       if (max_feats > 0 && n_feats >= max_feats) {
         // Python breaks out at the cap without validating the tail of
-        // the line; skipping (not erroring) matches that.
-        const char* c1;
-        const char* c2;
-        bool extra;
-        q = scan_token(q, line_end, &c1, &c2, &extra);
+        // the line; skipping (not erroring) matches that. Only the
+        // token boundary matters here, not its structure.
+        while (q < line_end && !is_ws(*q)) q++;
         continue;
       }
       if (!(simple_ok
@@ -926,10 +924,7 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
       if (q >= line_end) break;
       Token t;
       if (n_feats >= bb->max_feats) {  // cap: skip tail like Python
-        const char* c1;
-        const char* c2;
-        bool extra;
-        q = scan_token(q, line_end, &c1, &c2, &extra);
+        while (q < line_end && !is_ws(*q)) q++;  // boundary only
         continue;
       }
       if (!(simple_ok
