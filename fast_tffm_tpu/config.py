@@ -78,8 +78,9 @@ class FmConfig:
     # Reference knob (SURVEY Appendix A [L]): summary-writer cadence.
     # > 0 writes TensorBoard scalars (train loss, examples/sec,
     # validation AUC) every this many steps to <model_file>.tb/
-    # (utils/summaries.py; buffered and flushed at epoch barriers so the
-    # cadence never adds mid-stream device fetches). 0 = off.
+    # (utils/summaries.py; buffered and flushed at epoch barriers —
+    # no mid-stream device fetches up to the 1024-entry safety cap,
+    # one bulk fetch per cap hit beyond it). 0 = off.
     save_summaries_steps: int = 0
     # Cap per-epoch validation at this many batches PER INPUT SHARD
     # (process) — 0 = full sweep. At Criteo-1TB scale an every-epoch

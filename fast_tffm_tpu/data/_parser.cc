@@ -70,6 +70,9 @@ struct ShardOut {
   std::vector<float> vals;
   std::vector<int32_t> fields;   // field-aware (FFM) mode only
   std::vector<int64_t> linenos;  // per-example 1-based line number
+                                 // (filled only when keep_linenos)
+  int64_t lines_scanned = 0;  // lines walked by parse_range (left 0 on
+                              // a parse failure; callers fall back)
   bool failed = false;
   std::string error;
 };
@@ -378,11 +381,14 @@ inline int parse_token(const char* q, const char* tok_end,
 // implicit: we scan). `first_lineno` seeds the per-example line numbers
 // (and error messages). `keep_empty` turns blank lines into
 // zero-feature label-0 examples (the BatchBuilder's predict-alignment
-// mode); otherwise blanks are dropped.
+// mode); otherwise blanks are dropped. `keep_linenos` fills the
+// per-example linenos vector — only the streaming-builder feed reads
+// it, and this loop is the host throughput ceiling, so the block-parse
+// path must not pay the per-example push.
 void parse_range(const char* blob, const char* end, int64_t first_lineno,
                  int64_t vocab, bool hash_ids, bool field_aware,
                  int64_t field_num, int max_feats, bool keep_empty,
-                 ShardOut* out) {
+                 bool keep_linenos, ShardOut* out) {
   const char* p = blob;
   int64_t lineno = first_lineno;
   while (p < end) {
@@ -395,7 +401,7 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
       if (keep_empty) {
         out->labels.push_back(0.0f);
         out->sizes.push_back(0);
-        out->linenos.push_back(lineno);
+        if (keep_linenos) out->linenos.push_back(lineno);
       }
       p = line_end + 1;
       lineno++;
@@ -443,10 +449,11 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
       q = tok_end;
     }
     out->sizes.push_back(n_feats);
-    out->linenos.push_back(lineno);
+    if (keep_linenos) out->linenos.push_back(lineno);
     p = line_end + 1;
     lineno++;
   }
+  out->lines_scanned = lineno - first_lineno;
 }
 
 // Slice [blob, end) into <= T line-aligned ranges and parse them on T
@@ -456,7 +463,8 @@ std::vector<ShardOut> parse_threaded(const char* blob, const char* end,
                                      int64_t first_lineno, int T,
                                      int64_t vocab, bool hash_ids,
                                      bool field_aware, int64_t field_num,
-                                     int max_feats, bool keep_empty) {
+                                     int max_feats, bool keep_empty,
+                                     bool keep_linenos) {
   const int64_t blob_len = end - blob;
   std::vector<const char*> starts{blob};
   for (int t = 1; t < T; t++) {
@@ -483,7 +491,8 @@ std::vector<ShardOut> parse_threaded(const char* blob, const char* end,
   std::vector<ShardOut> outs(static_cast<size_t>(shards));
   if (shards == 1) {
     parse_range(starts[0], starts[1], lineno0[0], vocab, hash_ids,
-                field_aware, field_num, max_feats, keep_empty, &outs[0]);
+                field_aware, field_num, max_feats, keep_empty,
+                keep_linenos, &outs[0]);
     return outs;
   }
   std::vector<std::thread> threads;
@@ -491,7 +500,7 @@ std::vector<ShardOut> parse_threaded(const char* blob, const char* end,
     threads.emplace_back(parse_range, starts[size_t(s)],
                          starts[size_t(s) + 1], lineno0[size_t(s)], vocab,
                          hash_ids, field_aware, field_num, max_feats,
-                         keep_empty, &outs[size_t(s)]);
+                         keep_empty, keep_linenos, &outs[size_t(s)]);
   }
   for (auto& th : threads) th.join();
   return outs;
@@ -543,7 +552,8 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
 
   std::vector<ShardOut> outs = parse_threaded(
       blob, blob + blob_len, 0, T, vocab, hash_ids != 0, field_aware != 0,
-      field_num, max_feats, /*keep_empty=*/false);
+      field_num, max_feats, /*keep_empty=*/false,
+      /*keep_linenos=*/false);
 
   for (const auto& o : outs) {
     if (o.failed) {
@@ -784,9 +794,22 @@ int bb_feed_threaded(BatchBuilder* bb, const char* blob, int64_t blob_len,
   const int T = (end - blob) < (64 << 10) ? 1 : bb->T;
   std::vector<ShardOut> outs = parse_threaded(
       blob, end, bb->lineno + 1, T, bb->vocab, bb->hash_ids,
-      bb->field_aware, bb->field_num, bb->max_feats, bb->keep_empty);
-  for (const char* c = blob; c < end; c++) {
-    if (*c == '\n') bb->lineno++;
+      bb->field_aware, bb->field_num, bb->max_feats, bb->keep_empty,
+      /*keep_linenos=*/true);
+  // parse_range already walked every line; reuse its per-shard counts
+  // instead of rescanning the chunk's bytes for newlines ([blob, end)
+  // is newline-terminated, so lines == newlines). A failed shard
+  // leaves lines_scanned partial — fall back to the byte scan there to
+  // keep bb->lineno's post-error value unchanged (the stream is dead
+  // after the error reaches the consumer, but parity is free).
+  bool any_failed = false;
+  for (const auto& o : outs) any_failed |= o.failed;
+  if (any_failed) {
+    for (const char* c = blob; c < end; c++) {
+      if (*c == '\n') bb->lineno++;
+    }
+  } else {
+    for (const auto& o : outs) bb->lineno += o.lines_scanned;
   }
   for (const auto& o : outs) {
     // A failed shard still contributes the examples it completed

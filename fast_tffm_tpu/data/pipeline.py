@@ -350,7 +350,6 @@ def _iter_range_lines(path: str, start: int, end: int) -> Iterator[str]:
         yield tail.decode("utf-8")
 
 
-@functools.lru_cache(maxsize=512)
 def _owned_start_line_index(path: str, start: int) -> int:
     """Global line index of the first line OWNED by a byte range
     beginning at ``start`` (ownership rules of _iter_owned_chunks) == the
@@ -358,10 +357,21 @@ def _owned_start_line_index(path: str, start: int) -> int:
     memchr-speed scan (~GB/s) — it aligns line-parallel sidecar files
     (weight_files) with a byte-range data shard without parsing.
 
-    Memoized: train() builds a fresh iterator per epoch, and this value
-    is constant per (path, start) given the byte-range sharding's
-    standing assumption that input files don't change mid-run
-    (shard_byte_range re-reads only the size)."""
+    Memoized per file VERSION: train() builds a fresh iterator per
+    epoch and this value is constant per (path, start) given the
+    byte-range sharding's standing assumption that input files don't
+    change mid-run — but the cache is module-level, so a long-lived
+    process (pytest session, REPL) that rewrites the same path between
+    runs must not be served the old file's count; size+mtime_ns in the
+    key invalidates it."""
+    st = os.stat(path)
+    return _owned_start_line_index_for(path, start, st.st_size,
+                                       st.st_mtime_ns)
+
+
+@functools.lru_cache(maxsize=512)
+def _owned_start_line_index_for(path: str, start: int, _size: int,
+                                _mtime_ns: int) -> int:
     if start <= 0:
         return 0
     n = 0

@@ -12,9 +12,13 @@ once and training proceeds.
 Link-safety: scalar values may be DEVICE arrays; they are buffered
 as-is and fetched in one bulk ``jax.device_get`` at ``flush()`` —
 called from epoch boundaries, the same barrier the deferred loss log
-uses — so summaries never add mid-stream device fetches (BASELINE.md
-"Device-link sync pathology": one hot-loop scalar fetch costs seconds
-on a tunnelled link).
+uses — so summaries add no mid-stream device fetches up to
+SUMMARY_BUFFER_MAX retained entries (BASELINE.md "Device-link sync
+pathology": one hot-loop scalar fetch costs seconds on a tunnelled
+link). An epoch longer than SUMMARY_BUFFER_MAX/2 sampled cadences
+pays one bulk mid-epoch fetch per cap hit — the bound on retained
+device references is the lesser evil, and README/config state the
+same caveat.
 """
 
 from __future__ import annotations
