@@ -234,31 +234,61 @@ struct Token {
   float val;
 };
 
-// Single-pass fast path for the dominant token shape in non-hashed FM
-// data: `<int fid>[:<simple decimal>]`. Parses WHILE scanning — the
-// general path walks the token bytes twice (scan_token for structure,
-// then parse_int/parse_float over the same ranges), and this loop is
-// the host throughput ceiling. Returns 1 with (*tok_end_out, *t)
-// filled on success; 0 for ANYTHING unusual (sign, exponent, second
-// colon, out-of-range id, non-digit, overlong) — the caller then runs
-// the general scan+parse path, which owns all error semantics, so the
-// two paths cannot disagree on what's accepted (golden + property
-// tests pin that).
-inline int try_simple_fm_token(const char* q, const char* line_end,
-                               int64_t vocab, const char** tok_end_out,
-                               Token* t) {
+// Single-pass fast path for the dominant token shapes in every parse
+// mode: `<int fid>[:<simple decimal>]` (FM), the same with a hashed
+// string fid (any non-ws, non-colon bytes), and the field-aware
+// `<int field>:<fid>[:<simple decimal>]` (FFM, hashed or not). Parses
+// WHILE scanning — the general path walks the token bytes twice
+// (scan_token for structure, then parse_int/parse_float/murmur over
+// the same ranges), and this loop is the host throughput ceiling.
+// Returns 1 with (*tok_end_out, *t) filled on success; 0 for ANYTHING
+// unusual (sign, exponent, surplus colon, out-of-range field/id,
+// empty id, overlong) — the caller then runs the general scan+parse
+// path, which owns all error semantics, so the two paths cannot
+// disagree on what's accepted (golden + property tests pin that).
+inline int try_fast_token(const char* q, const char* line_end,
+                          int64_t vocab, bool hash_ids, bool field_aware,
+                          int64_t field_num, const char** tok_end_out,
+                          Token* t) {
   const char* p = q;
-  uint64_t fid = 0;
-  int digs = 0;
-  while (p < line_end) {
-    const char c = *p;
-    if (c < '0' || c > '9') break;
-    fid = fid * 10 + uint64_t(c - '0');
-    if (fid && ++digs > 18) return 0;
+  if (field_aware) {
+    uint64_t fld = 0;
+    int fdigs = 0;
+    while (p < line_end) {
+      const char c = *p;
+      if (c < '0' || c > '9') break;
+      fld = fld * 10 + uint64_t(c - '0');
+      if (fld && ++fdigs > 9) return 0;  // overlong field: general path
+      p++;
+    }
+    // Needs digits then ':' (sign, string field, bare token: fall back)
+    if (p == q || p >= line_end || *p != ':') return 0;
+    if (fld >= uint64_t(field_num)) return 0;  // general path raises
+    t->field = int32_t(fld);
     p++;
+  } else {
+    t->field = 0;
   }
-  if (p == q) return 0;  // no leading digits (sign, string id, ...)
-  if (fid >= uint64_t(vocab)) return 0;  // general path raises properly
+  if (hash_ids) {
+    const char* id0 = p;
+    while (p < line_end && !is_ws(*p) && *p != ':') p++;
+    if (p == id0) return 0;  // empty id: general path owns acceptance
+    t->row = int32_t(murmur64(id0, size_t(p - id0), 0) % uint64_t(vocab));
+  } else {
+    const char* id0 = p;
+    uint64_t fid = 0;
+    int digs = 0;
+    while (p < line_end) {
+      const char c = *p;
+      if (c < '0' || c > '9') break;
+      fid = fid * 10 + uint64_t(c - '0');
+      if (fid && ++digs > 18) return 0;
+      p++;
+    }
+    if (p == id0) return 0;  // no digits (sign, string id, ...)
+    if (fid >= uint64_t(vocab)) return 0;  // general path raises
+    t->row = int32_t(fid);
+  }
   if (p >= line_end || is_ws(*p)) {
     t->val = 1.0f;
   } else if (*p == ':') {
@@ -285,10 +315,8 @@ inline int try_simple_fm_token(const char* q, const char* line_end,
     if (!any || frac > 22) return 0;
     t->val = float(double(mant) / kPow10[frac]);
   } else {
-    return 0;  // fid runs into non-digit, non-colon, non-ws bytes
+    return 0;  // id runs into non-digit, non-colon, non-ws bytes
   }
-  t->row = int32_t(fid);
-  t->field = 0;
   *tok_end_out = p;
   return 1;
 }
@@ -417,7 +445,6 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
     }
     out->labels.push_back(label);
     int32_t n_feats = 0;
-    const bool simple_ok = !hash_ids && !field_aware;
     q = tok_end;
     while (true) {
       while (q < line_end && is_ws(*q)) q++;
@@ -430,8 +457,8 @@ void parse_range(const char* blob, const char* end, int64_t first_lineno,
         while (q < line_end && !is_ws(*q)) q++;
         continue;
       }
-      if (!(simple_ok
-            && try_simple_fm_token(q, line_end, vocab, &tok_end, &t))) {
+      if (!try_fast_token(q, line_end, vocab, hash_ids, field_aware,
+                          field_num, &tok_end, &t)) {
         const char* c1;
         const char* c2;
         bool extra;
@@ -940,7 +967,6 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
     int n_feats = 0;
     bb->line_slots.clear();
     const int32_t saved_uniq = bb->n_uniq;
-    const bool simple_ok = !bb->hash_ids && !bb->field_aware;
     q = tok_end;
     while (true) {
       while (q < line_end && is_ws(*q)) q++;
@@ -950,9 +976,9 @@ int fm_bb_feed(void* h, const char* blob, int64_t blob_len,
         while (q < line_end && !is_ws(*q)) q++;  // boundary only
         continue;
       }
-      if (!(simple_ok
-            && try_simple_fm_token(q, line_end, bb->vocab, &tok_end,
-                                   &t))) {
+      if (!try_fast_token(q, line_end, bb->vocab, bb->hash_ids,
+                          bb->field_aware, bb->field_num, &tok_end,
+                          &t)) {
         const char* c1;
         const char* c2;
         bool extra;

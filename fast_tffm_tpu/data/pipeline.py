@@ -362,16 +362,18 @@ def _owned_start_line_index(path: str, start: int) -> int:
     byte-range sharding's standing assumption that input files don't
     change mid-run — but the cache is module-level, so a long-lived
     process (pytest session, REPL) that rewrites the same path between
-    runs must not be served the old file's count; size+mtime_ns in the
-    key invalidates it."""
+    runs must not be served the old file's count; size+mtime_ns+inode
+    in the key invalidates rewrites (inode catches the common
+    regenerate-then-rename) short of an in-place same-size rewrite
+    inside one mtime clock tick, which no stat-based key can see."""
     st = os.stat(path)
     return _owned_start_line_index_for(path, start, st.st_size,
-                                       st.st_mtime_ns)
+                                       st.st_mtime_ns, st.st_ino)
 
 
 @functools.lru_cache(maxsize=512)
 def _owned_start_line_index_for(path: str, start: int, _size: int,
-                                _mtime_ns: int) -> int:
+                                _mtime_ns: int, _ino: int) -> int:
     if start <= 0:
         return 0
     n = 0
