@@ -28,9 +28,11 @@ between rounds, the same JSON carries the attribution breakdown:
   config #3 shapes: Avazu-like ~24 fields, k=4) through the same C++
   fast path — FFM's own bench line,
 - ``order3_e2e``: end-to-end rate of the order-3 ANOVA-kernel FM
-  (BASELINE config #4 shapes) — the higher-order capability's line.
+  (BASELINE config #4 shapes) — the higher-order capability's line,
+- ``hashed_e2e``: end-to-end rate with ``hash_feature_id`` on (configs
+  #2/#5 hash string ids; the headline uses plain int ids).
 
-Every e2e line (headline, ffm, order3, k16) is the median of TRIALS
+Every e2e line (headline, ffm, order3, hashed, k16) is the median of TRIALS
 runs with the per-trial values alongside: a single late-in-the-run
 trial can read 8x low on a tunnelled chip (measured), and the medians
 make that attributable instead of alarming.
@@ -274,6 +276,18 @@ def _enable_compile_cache():
     _enable_compilation_cache()
 
 
+def run_hashed_e2e(train_path):
+    """Hashed-id FM end-to-end trials: configs #2 (Criteo-1TB) and #5
+    (1e9-feature iPinYou) both hash string ids, so the hashed parse +
+    murmur path gets its own e2e line (the headline uses plain int ids).
+    Reuses the headline data file — its int ids hash like any string."""
+    import dataclasses
+    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
+    cfg = dataclasses.replace(make_cfg(train_path), hash_feature_id=True)
+    step = make_train_step(ModelSpec.from_config(cfg))
+    return [run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS)]
+
+
 def _run_line(name, train_path):
     """One secondary e2e line by name -> its result dict. The single
     dispatch both the subprocess entry and the in-process fallback go
@@ -283,6 +297,8 @@ def _run_line(name, train_path):
         return {"trials": run_ffm_e2e(tmp)}
     if name == "order3":
         return {"trials": run_order3_e2e(tmp)}
+    if name == "hashed":
+        return {"trials": run_hashed_e2e(train_path)}
     if name == "k16":
         import dataclasses
         e2e, dev = run_k16(dataclasses.replace(make_cfg(train_path),
@@ -379,6 +395,7 @@ def main():
         # that), and nothing below needs to have run before them.
         ffm_res = _isolated_line("ffm", path)
         order3_res = _isolated_line("order3", path)
+        hashed_res = _isolated_line("hashed", path)
         k16_res = _isolated_line("k16", path)
 
         cfg = make_cfg(path)
@@ -399,11 +416,12 @@ def main():
         # fallback's compiled programs cannot contaminate the headline
         # (see _isolated_line).
         for name, res in (("ffm", ffm_res), ("order3", order3_res),
-                          ("k16", k16_res)):
+                          ("hashed", hashed_res), ("k16", k16_res)):
             if res["isolation"] == "failed":
                 res.update(_run_line(name, path))
                 res["isolation"] = "in-process"
         ffm, order3 = ffm_res["trials"], order3_res["trials"]
+        hashed = hashed_res["trials"]
         k16, k16_dev = k16_res["trials"], k16_res["device"]
 
     def med(trials):  # None survives a timed-out line (see _isolated_line)
@@ -430,17 +448,21 @@ def main():
         "order3_e2e": med(order3),
         "order3_e2e_trials":
             [round(v, 1) for v in order3] if order3 else None,
+        "hashed_e2e": med(hashed),
+        "hashed_e2e_trials":
+            [round(v, 1) for v in hashed] if hashed else None,
         "k16_e2e": med(k16),
         "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
         "k16_device_pallas": round(k16_dev["pallas"], 1) if k16_dev
         else None,
         "k16_device_xla": round(k16_dev["xla"], 1) if k16_dev else None,
-        # Whether each of ffm/order3/k16 actually ran in a fresh process
+        # Whether each isolated line actually ran in a fresh process
         # (see _isolated_line on the measured in-process cross-program
         # degradation); "in-process" marks a fallback whose number
         # carries that caveat.
         "line_isolation": {"ffm": ffm_res["isolation"],
                            "order3": order3_res["isolation"],
+                           "hashed": hashed_res["isolation"],
                            "k16": k16_res["isolation"]},
     }))
 
