@@ -196,28 +196,52 @@ inline bool parse_float(const char* begin, const char* end, float* out) {
   return parse_float_slow(begin, end, out);
 }
 
-inline bool parse_int(const char* begin, const char* end, int64_t* out) {
-  if (begin == end) return false;
+// 0 = parsed; 1 = not integer syntax; 2 = integer syntax but > 18
+// significant digits (magnitude beyond any vocab/field range — callers
+// must report it as OUT OF RANGE, not non-integer, to match Python's
+// arbitrary-precision int() + range check).
+inline int parse_int_status(const char* begin, const char* end,
+                            int64_t* out) {
+  if (begin == end) return 1;
   const char* p = begin;
   bool neg = false;
   if (*p == '+' || *p == '-') {
     neg = (*p == '-');
     p++;
   }
-  if (p == end) return false;
+  if (p == end) return 1;
   uint64_t v = 0;
   int digits = 0;
+  bool over = false;
   for (; p < end; p++) {
     char c = *p;
-    if (c < '0' || c > '9') return false;
-    v = v * 10 + uint64_t(c - '0');
-    // Significant digits only: zero-padded ids ("000...05") must parse
-    // like Python int(). 18 significant digits can't overflow and any
-    // id that long is out of every vocab's range anyway.
-    if (v && ++digits > 18) return false;
+    if (c < '0' || c > '9') return 1;
+    if (!over) {
+      v = v * 10 + uint64_t(c - '0');
+      // Significant digits only: zero-padded ids ("000...05") must
+      // parse like Python int(). 18 significant digits can't overflow.
+      if (v && ++digits > 18) over = true;
+    }
   }
+  if (over) return 2;
   *out = neg ? -int64_t(v) : int64_t(v);
-  return true;
+  return 0;
+}
+
+// Python-int repr of an integer-syntax token span: sign only when
+// negative and nonzero, leading zeros stripped — what Python's
+// f"{int(s)}" renders in range-error messages, valid for spans that
+// overflowed int64 too.
+inline std::string canon_int(const char* begin, const char* end) {
+  const char* p = begin;
+  bool neg = false;
+  if (p < end && (*p == '+' || *p == '-')) {
+    neg = (*p == '-');
+    p++;
+  }
+  while (p < end && *p == '0') p++;
+  if (p == end) return "0";
+  return (neg ? "-" : "") + std::string(p, end);
 }
 
 void fail(ShardOut* out, int64_t lineno, const std::string& msg) {
@@ -357,13 +381,14 @@ inline int parse_token(const char* q, const char* tok_end,
              "' (want field:fid[:val])";
       return 1;
     }
-    int64_t fld;
-    if (!parse_int(q, c1, &fld)) {
+    int64_t fld = 0;
+    const int fst = parse_int_status(q, c1, &fld);
+    if (fst == 1) {
       *err = "bad field '" + std::string(q, c1) + "'";
       return 1;
     }
-    if (fld < 0 || fld >= field_num) {
-      *err = "field " + std::to_string(fld) + " out of range [0, " +
+    if (fst == 2 || fld < 0 || fld >= field_num) {
+      *err = "field " + canon_int(q, c1) + " out of range [0, " +
              std::to_string(field_num) + ")";
       return 1;
     }
@@ -384,15 +409,16 @@ inline int parse_token(const char* q, const char* tok_end,
     t->row = int32_t(murmur64(fid_begin, size_t(fid_end - fid_begin), 0) %
                      uint64_t(vocab));
   } else {
-    int64_t fid;
-    if (!parse_int(fid_begin, fid_end, &fid)) {
+    int64_t fid = 0;
+    const int st = parse_int_status(fid_begin, fid_end, &fid);
+    if (st == 1) {
       *err = "non-integer feature id '" + std::string(fid_begin, fid_end) +
              "' (set hash_feature_id = True for string ids)";
       return 1;
     }
-    if (fid < 0 || fid >= vocab) {
-      *err = "feature id " + std::to_string(fid) + " out of range [0, " +
-             std::to_string(vocab) + ")";
+    if (st == 2 || fid < 0 || fid >= vocab) {
+      *err = "feature id " + canon_int(fid_begin, fid_end) +
+             " out of range [0, " + std::to_string(vocab) + ")";
       return 1;
     }
     t->row = int32_t(fid);

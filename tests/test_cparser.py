@@ -26,6 +26,17 @@ def assert_parity(lines, vocab, **kw):
         np.testing.assert_array_equal(cc.fields, py.fields)
 
 
+def assert_error_message_parity(lines, vocab, **kw):
+    """Both parsers reject AND produce the identical message (the error
+    text is part of the parity contract: it names the line and the
+    offending value the way Python renders it)."""
+    with pytest.raises(ParseError) as py_err:
+        parse_lines(lines, vocab, **kw)
+    with pytest.raises(ParseError) as cc_err:
+        cparser.parse_lines_fast(lines, vocab, **kw)
+    assert str(cc_err.value) == str(py_err.value)
+
+
 def test_basic_parity():
     assert_parity(["1 3:0.5 7:2.0 1", "0 2", "1 9:1.5"], 100)
 
@@ -61,6 +72,26 @@ def test_error_parity():
             parse_lines(bad, 10)
         with pytest.raises(ParseError):
             cparser.parse_lines_fast(bad, 10)
+
+
+def test_overlong_int_error_message_parity():
+    """Integer-syntax ids beyond int64 must report OUT OF RANGE with
+    Python's arbitrary-precision rendering, not 'non-integer' (found by
+    differential fuzz: C++'s int64 parse overflowed to a syntax error
+    while Python's int() parsed and range-checked)."""
+    for bad in (["1 999999999999999999999:1"],     # 21 digits
+                ["1 1000000000000000000:1"],       # 19 digits, fits int64
+                ["1 9223372036854775808:1"],       # int64 max + 1
+                ["1 -999999999999999999999:1"],    # negative overlong
+                ["1 +999999999999999999999:1"],    # sign stripped in repr
+                ["1 0000999999999999999999999:1"],  # zero-padded overlong
+                ["1 55:1"]):                       # plain out of range
+        assert_error_message_parity(bad, 50)
+    # FFM field: same class through the field branch.
+    for bad in (["1 99999999999999999999:3:1"],
+                ["1 -99999999999999999999:3:1"],
+                ["1 7:3:1"]):
+        assert_error_message_parity(bad, 50, field_aware=True, field_num=4)
 
 
 def test_random_fuzz_parity(rng):
