@@ -30,9 +30,12 @@ between rounds, the same JSON carries the attribution breakdown:
 - ``order3_e2e``: end-to-end rate of the order-3 ANOVA-kernel FM
   (BASELINE config #4 shapes) — the higher-order capability's line,
 - ``hashed_e2e``: end-to-end rate with ``hash_feature_id`` on (configs
-  #2/#5 hash string ids; the headline uses plain int ids).
+  #2/#5 hash string ids; the headline uses plain int ids),
+- ``predict_e2e``: batch-scoring rate through the real predict path
+  (the reference's second workload: parse keep_empty -> score ->
+  ordered scores).
 
-Every e2e line (headline, ffm, order3, hashed, k16) is the median of TRIALS
+Every e2e line (headline, ffm, order3, hashed, predict, k16) is the median of TRIALS
 runs with the per-trial values alongside: a single late-in-the-run
 trial can read 8x low on a tunnelled chip (measured), and the medians
 make that attributable instead of alarming.
@@ -288,6 +291,27 @@ def run_hashed_e2e(train_path):
     return [run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS)]
 
 
+def run_predict_e2e(train_path):
+    """Batch-scoring throughput — the reference's second workload
+    (SURVEY §3.4: file -> parse(keep_empty, line-aligned) -> score ->
+    ordered scores): examples/sec over full sweeps of the headline file
+    through the real predict path (fast_tffm_tpu.predict.predict_scores,
+    chunked device fetches included). Sweep 0 pays the compiles and is
+    discarded."""
+    from fast_tffm_tpu.models.fm import init_table
+    from fast_tffm_tpu.predict import predict_scores
+    cfg = make_cfg(train_path)
+    table = init_table(cfg, 0)
+    rates = []
+    for i in range(TRIALS + 1):
+        t0 = time.perf_counter()
+        scores = predict_scores(cfg, table, cfg.train_files)
+        dt = time.perf_counter() - t0
+        if i:
+            rates.append(scores.shape[0] / dt)
+    return rates
+
+
 def _run_line(name, train_path):
     """One secondary e2e line by name -> its result dict. The single
     dispatch both the subprocess entry and the in-process fallback go
@@ -299,6 +323,8 @@ def _run_line(name, train_path):
         return {"trials": run_order3_e2e(tmp)}
     if name == "hashed":
         return {"trials": run_hashed_e2e(train_path)}
+    if name == "predict":
+        return {"trials": run_predict_e2e(train_path)}
     if name == "k16":
         import dataclasses
         e2e, dev = run_k16(dataclasses.replace(make_cfg(train_path),
@@ -396,6 +422,7 @@ def main():
         ffm_res = _isolated_line("ffm", path)
         order3_res = _isolated_line("order3", path)
         hashed_res = _isolated_line("hashed", path)
+        predict_res = _isolated_line("predict", path)
         k16_res = _isolated_line("k16", path)
 
         cfg = make_cfg(path)
@@ -416,12 +443,13 @@ def main():
         # fallback's compiled programs cannot contaminate the headline
         # (see _isolated_line).
         for name, res in (("ffm", ffm_res), ("order3", order3_res),
-                          ("hashed", hashed_res), ("k16", k16_res)):
+                          ("hashed", hashed_res), ("predict", predict_res),
+                          ("k16", k16_res)):
             if res["isolation"] == "failed":
                 res.update(_run_line(name, path))
                 res["isolation"] = "in-process"
         ffm, order3 = ffm_res["trials"], order3_res["trials"]
-        hashed = hashed_res["trials"]
+        hashed, pred = hashed_res["trials"], predict_res["trials"]
         k16, k16_dev = k16_res["trials"], k16_res["device"]
 
     def med(trials):  # None survives a timed-out line (see _isolated_line)
@@ -451,6 +479,9 @@ def main():
         "hashed_e2e": med(hashed),
         "hashed_e2e_trials":
             [round(v, 1) for v in hashed] if hashed else None,
+        "predict_e2e": med(pred),
+        "predict_e2e_trials":
+            [round(v, 1) for v in pred] if pred else None,
         "k16_e2e": med(k16),
         "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
         "k16_device_pallas": round(k16_dev["pallas"], 1) if k16_dev
@@ -463,6 +494,7 @@ def main():
         "line_isolation": {"ffm": ffm_res["isolation"],
                            "order3": order3_res["isolation"],
                            "hashed": hashed_res["isolation"],
+                           "predict": predict_res["isolation"],
                            "k16": k16_res["isolation"]},
     }))
 
