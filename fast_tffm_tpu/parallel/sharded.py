@@ -80,7 +80,18 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
 def _require_host_dedup(spec: ModelSpec) -> None:
     """Mesh steps consume the host-side unique contract (uniq_ids with
     fixed buckets; global_batch offsets local_idx into the concatenated
-    unique axis) — a raw-ids spec here would feed garbage indices."""
+    unique axis) — a raw-ids spec here would feed garbage indices.
+
+    Design position, not a gap: on a single chip raw ids win because
+    the only cost is H2D bytes and an on-chip unique (~3 us), while
+    host dedup burns the scarce 1-core host. On a mesh the economics
+    invert — the gather/scatter against the ROW-SHARDED table is
+    cross-device traffic sized by the index vector, so deduping
+    B*L raw slots down to U uniques host-side shrinks the all-to-all
+    and the scatter-add by the batch's duplication factor, and the
+    fixed-U lockstep protocol (multi-process global_batch) needs the
+    static unique budget anyway. Shipping raw ids to the mesh would
+    trade cheap distributed host CPU for scarce ICI bandwidth."""
     if spec.dedup == "device":
         raise ValueError(
             "dedup = device is single-device only; mesh paths require "
