@@ -446,8 +446,17 @@ def main():
                           ("hashed", hashed_res), ("predict", predict_res),
                           ("k16", k16_res)):
             if res["isolation"] == "failed":
-                res.update(_run_line(name, path))
-                res["isolation"] = "in-process"
+                # A reproducible crash (not a spawn flake) raises here
+                # too — record the null line rather than aborting main()
+                # and losing the measurements already taken.
+                try:
+                    res.update(_run_line(name, path))
+                    res["isolation"] = "in-process"
+                except Exception as e:  # noqa: BLE001 - artifact survival
+                    import sys
+                    print(f"bench line {name}: in-process fallback also "
+                          f"failed ({type(e).__name__}: {e}); recording "
+                          f"null", file=sys.stderr)
         ffm, order3 = ffm_res["trials"], order3_res["trials"]
         hashed, pred = hashed_res["trials"], predict_res["trials"]
         k16, k16_dev = k16_res["trials"], k16_res["device"]

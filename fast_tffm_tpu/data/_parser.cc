@@ -69,12 +69,17 @@ struct ShardOut {
   std::vector<int32_t> ids;
   std::vector<float> vals;
   std::vector<int32_t> fields;   // field-aware (FFM) mode only
-  std::vector<int64_t> linenos;  // per-example 1-based line number
-                                 // (filled only when keep_linenos)
+  std::vector<int64_t> linenos;  // per-example line number (filled only
+                                 // when keep_linenos; base = caller's
+                                 // first_lineno convention)
   int64_t lines_scanned = 0;  // lines walked by parse_range (left 0 on
                               // a parse failure; callers fall back)
   bool failed = false;
-  std::string error;
+  // Error site, kept as (lineno, message) instead of preformatted text
+  // so parse_threaded can rebase shard-relative linenos after the join
+  // (shards must not pre-scan for absolute offsets — see there).
+  int64_t error_lineno = 0;
+  std::string error_msg;
 };
 
 // Byte class table for the separator test: one L1-resident load beats
@@ -246,7 +251,13 @@ inline std::string canon_int(const char* begin, const char* end) {
 
 void fail(ShardOut* out, int64_t lineno, const std::string& msg) {
   out->failed = true;
-  out->error = "line " + std::to_string(lineno) + ": " + msg;
+  out->error_lineno = lineno;
+  out->error_msg = msg;
+}
+
+// "line N: msg" — the one rendering of a shard's error site.
+std::string shard_error(const ShardOut& o) {
+  return "line " + std::to_string(o.error_lineno) + ": " + o.error_msg;
 }
 
 // One feature token parsed. FM: `fid[:val]`; field-aware (FFM):
@@ -531,31 +542,44 @@ std::vector<ShardOut> parse_threaded(const char* blob, const char* end,
   starts.push_back(end);
   int shards = int(starts.size()) - 1;
 
-  // Line-number offsets per shard (error messages + pending linenos).
-  std::vector<int64_t> lineno0(size_t(shards), first_lineno);
-  for (int s = 1; s < shards; s++) {
-    int64_t count = 0;
-    for (const char* c = starts[s - 1]; c < starts[s]; c++) {
-      if (*c == '\n') count++;
-    }
-    lineno0[size_t(s)] = lineno0[size_t(s - 1)] + count;
-  }
-
   std::vector<ShardOut> outs(static_cast<size_t>(shards));
   if (shards == 1) {
-    parse_range(starts[0], starts[1], lineno0[0], vocab, hash_ids,
+    parse_range(starts[0], starts[1], first_lineno, vocab, hash_ids,
                 field_aware, field_num, max_feats, keep_empty,
                 keep_linenos, &outs[0]);
     return outs;
   }
+  // Shards past the first parse with RELATIVE linenos (base 0) and are
+  // rebased after the join from the earlier shards' lines_scanned —
+  // the alternative (pre-scanning [starts[0], starts[N-1]) for
+  // newlines to seed absolute offsets) is a serial O(blob) walk on the
+  // calling thread before any parse thread starts, an Amdahl cap on
+  // exactly the loop this parallelism exists to speed up.
   std::vector<std::thread> threads;
   for (int s = 0; s < shards; s++) {
     threads.emplace_back(parse_range, starts[size_t(s)],
-                         starts[size_t(s) + 1], lineno0[size_t(s)], vocab,
+                         starts[size_t(s) + 1],
+                         s == 0 ? first_lineno : 0, vocab,
                          hash_ids, field_aware, field_num, max_feats,
                          keep_empty, keep_linenos, &outs[size_t(s)]);
   }
   for (auto& th : threads) th.join();
+  // Rebase: shard s's absolute base = first_lineno + lines before it.
+  // A failed shard's lines_scanned is 0/partial, but every shard after
+  // the first failure is dropped by both consumers (stitch and feed
+  // break at the failed shard), so their linenos never surface.
+  int64_t base = outs[0].lines_scanned;  // shard 0 is already absolute
+  bool dead = outs[0].failed;
+  for (int s = 1; s < shards && !dead; s++) {
+    ShardOut& o = outs[size_t(s)];
+    const int64_t delta = first_lineno + base;
+    for (int64_t& ln : o.linenos) ln += delta;
+    if (o.failed) {
+      o.error_lineno += delta;
+      dead = true;
+    }
+    base += o.lines_scanned;
+  }
   return outs;
 }
 
@@ -610,7 +634,8 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
 
   for (const auto& o : outs) {
     if (o.failed) {
-      std::snprintf(err_out, size_t(err_cap), "%s", o.error.c_str());
+      std::snprintf(err_out, size_t(err_cap), "%s",
+                    shard_error(o).c_str());
       return 1;
     }
   }
@@ -886,7 +911,7 @@ int bb_feed_threaded(BatchBuilder* bb, const char* blob, int64_t blob_len,
     }
     if (o.failed) {
       bb->p_failed = true;
-      bb->p_error = o.error;
+      bb->p_error = shard_error(o);
       break;  // later shards' examples come after the error: dropped
     }
   }

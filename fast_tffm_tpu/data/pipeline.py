@@ -593,10 +593,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
     # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
     # no feature needs per-line Python handling — including sharded
     # multi-process input (byte ranges), field-aware FFM tokens, and
-    # keep_empty line alignment (predict). Requires a hard per-example
-    # cap (the builder writes fixed-stride rows);
-    # max_features_per_example = 0 means "unlimited" and stays generic.
-    if not weight_files and cfg.max_features_per_example > 0:
+    # keep_empty line alignment (predict).
+    if _fast_path_eligible(cfg, weight_files):
         try:
             from fast_tffm_tpu.data.cparser import BatchBuilder
             # A ladder value (power of two past the top), so batches with
@@ -768,20 +766,33 @@ def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None,
                              uniq_bucket=uniq_bucket or cfg.uniq_bucket)
 
 
+def _fast_path_eligible(cfg: FmConfig,
+                        weight_files: Sequence[str]) -> bool:
+    """The ONE gate for the chunked C++ fast path: no per-line Python
+    handling (weight sidecars pair weights to lines in Python) and a
+    hard per-example cap (the builder writes fixed-stride rows;
+    max_features_per_example = 0 means "unlimited" and stays generic).
+    batch_iterator's path selection and gil_bound_iteration's
+    GIL-contention answer must agree, so both call here — a hand-copied
+    predicate drifting between them would silently thread a GIL-bound
+    iterator (or passthrough a releasing one)."""
+    return not weight_files and cfg.max_features_per_example > 0
+
+
 def gil_bound_iteration(cfg: FmConfig, weight_files: Sequence[str] = (),
                         keep_empty: bool = False) -> bool:
     """Whether batch_iterator's parsing for these inputs holds the GIL
-    (pure-Python parser) — the SAME path selection batch_iterator makes,
-    exposed so prefetch callers can gate the worker thread on it. Python
-    parsing happens when the C++ extension is unavailable, or on the
-    generic path's one parse=None case (keep_empty without the fast
-    path). The generic weighted path block-parses via the C++
-    parse_lines_fast, which releases the GIL."""
+    (pure-Python parser) — the SAME path selection batch_iterator makes
+    (_fast_path_eligible), exposed so prefetch callers can gate the
+    worker thread on it. Python parsing happens when the C++ extension
+    is unavailable, or on the generic path's one parse=None case
+    (keep_empty without the fast path). The generic weighted path
+    block-parses via the C++ parse_lines_fast, which releases the
+    GIL."""
     from fast_tffm_tpu.data import cparser
     if not cparser.available():
         return True
-    fast = not weight_files and cfg.max_features_per_example > 0
-    return (not fast) and keep_empty
+    return (not _fast_path_eligible(cfg, weight_files)) and keep_empty
 
 
 def prefetch(iterator: Iterator[DeviceBatch], depth: int = 2,

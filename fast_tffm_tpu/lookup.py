@@ -506,7 +506,11 @@ class PinnedHostLookup:
     def from_checkpoint(cls, cfg: FmConfig, with_acc: bool = True,
                         mode: Optional[str] = None) -> "PinnedHostLookup":
         """Restore into accelerator-host memory (via the host-numpy
-        restore path, then one placement copy)."""
+        restore path, then one placement copy). The local numpy copy is
+        TRANSIENT — ``host`` dies at return, so steady state is one
+        copy in accelerator-host memory; the peak overlaps local RAM
+        (reading the checkpoint requires it) with the remote placement,
+        not 2x of either."""
         host = HostOffloadLookup.from_checkpoint(cfg, with_acc=with_acc)
         self = cls(cfg, _init=False, mode=mode)
         self.load(host.table, host.acc)

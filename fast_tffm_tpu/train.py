@@ -215,6 +215,15 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                                           make_offload_backend,
                                           make_offload_train_step)
         lk = make_offload_backend(cfg, cfg.seed, restored=restored)
+        if restored is not None:
+            # The backend adopted the arrays (numpy backend: zero-copy)
+            # or copied them into accelerator-host memory (pinned
+            # backend); keeping these references for the rest of
+            # train() would pin a SECOND full table+accumulator in
+            # local RAM for the whole resumed run — a sustained 2x that
+            # is an OOM at config-#5 scale (the same concern
+            # HostOffloadLookup.load documents for transient copies).
+            restored["table"] = restored["acc"] = None
         kind = (f"pinned-host in-jit ({lk.mode})"
                 if isinstance(lk, PinnedHostLookup) else "host-numpy")
         logger.info("offload lookup [%s]: table [%d, %d] outside HBM "

@@ -74,6 +74,36 @@ def test_error_parity():
             cparser.parse_lines_fast(bad, 10)
 
 
+def test_threaded_error_lineno_rebase_large_blob():
+    """A parse error landing in a LATER shard of a genuinely
+    multi-shard parse (>64KB blob, so the threaded path really splits)
+    must report the ABSOLUTE line number: later shards parse with
+    relative linenos and are rebased after the join from earlier
+    shards' line counts — this pins the rebase math on both consumers
+    (block parse and streaming builder feed)."""
+    n = 6000
+    lines = [f"1 {i % 499}:0.25 {(i * 7) % 499}:1" for i in range(n)]
+    bad_at = n - 100  # deep in the last shard at T=4
+    lines[bad_at] = "1 botched:token"
+    # Block-parse surface (0-based linenos, matching Python enumerate).
+    with pytest.raises(ParseError) as py_err:
+        parse_lines(lines, 500)
+    with pytest.raises(ParseError) as cc_err:
+        cparser.parse_lines_fast(lines, 500, num_threads=4)
+    assert str(cc_err.value) == str(py_err.value)
+    assert f"line {bad_at}:" in str(cc_err.value)
+    # Streaming-builder surface (1-based linenos): the T=4 feed must
+    # report the same absolute line as the T=1 feed.
+    blob = ("\n".join(lines) + "\n").encode()
+    assert len(blob) > (64 << 10)  # the threaded gate must be open
+    want, err_w = _run_builder(blob, [blob], 1)
+    got, err_g = _run_builder(blob, [blob], 4)
+    assert err_w is not None and err_g is not None
+    assert err_w == err_g
+    assert f"line {bad_at + 1}:" in err_g
+    _assert_batches_equal(got, want)
+
+
 def test_overlong_int_error_message_parity():
     """Integer-syntax ids beyond int64 must report OUT OF RANGE with
     Python's arbitrary-precision rendering, not 'non-integer' (found by
