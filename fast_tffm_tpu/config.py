@@ -290,13 +290,23 @@ def load_config(path: str) -> FmConfig:
         raise FileNotFoundError(path)
 
     kwargs = {}
+    _sections = {"General": _GENERAL_KEYS, "Train": _TRAIN_KEYS,
+                 "Predict": _PREDICT_KEYS, "Cluster": _CLUSTER_KEYS}
 
     def consume(section: str, keys):
         if not cp.has_section(section):
             return
         for name, raw in cp.items(section):
             if name not in keys:
-                raise KeyError(f"unknown config key [{section}] {name}")
+                # A key that exists in ANOTHER section is the common
+                # miss (e.g. the lookup/kernel/dedup extension knobs
+                # live in [General]); name the right home in the error.
+                home = next((s for s, k in _sections.items()
+                             if name in k), None)
+                hint = (f" (this key belongs in [{home}])"
+                        if home else "")
+                raise KeyError(
+                    f"unknown config key [{section}] {name}{hint}")
             conv = keys[name]
             if conv is bool:
                 kwargs[name] = cp.getboolean(section, name)

@@ -129,6 +129,20 @@ def test_unknown_key_fails_loudly(tmp_path):
         load_config(path)
 
 
+def test_known_key_in_wrong_section_names_its_home(tmp_path):
+    """A key placed in the wrong section (the common miss for the
+    [General]-homed extension knobs) errors with a pointer to the right
+    section; a true typo gets no misleading hint."""
+    path = write_cfg(tmp_path, """
+        [General]
+        vocabulary_size = 100
+        [Train]
+        lookup = host
+    """)
+    with pytest.raises(KeyError, match=r"belongs in \[General\]"):
+        load_config(path)
+
+
 def test_missing_file():
     with pytest.raises(FileNotFoundError):
         load_config("/nonexistent/x.cfg")
