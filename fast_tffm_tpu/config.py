@@ -290,8 +290,10 @@ def load_config(path: str) -> FmConfig:
         raise FileNotFoundError(path)
 
     kwargs = {}
-    _sections = {"General": _GENERAL_KEYS, "Train": _TRAIN_KEYS,
-                 "Predict": _PREDICT_KEYS, "Cluster": _CLUSTER_KEYS}
+    # The one section->keys mapping: drives both the consume loop and
+    # the wrong-section hint, so the two cannot diverge.
+    sections = {"General": _GENERAL_KEYS, "Train": _TRAIN_KEYS,
+                "Predict": _PREDICT_KEYS, "Cluster": _CLUSTER_KEYS}
 
     def consume(section: str, keys):
         if not cp.has_section(section):
@@ -301,7 +303,7 @@ def load_config(path: str) -> FmConfig:
                 # A key that exists in ANOTHER section is the common
                 # miss (e.g. the lookup/kernel/dedup extension knobs
                 # live in [General]); name the right home in the error.
-                home = next((s for s, k in _sections.items()
+                home = next((s for s, k in sections.items()
                              if name in k), None)
                 hint = (f" (this key belongs in [{home}])"
                         if home else "")
@@ -313,10 +315,8 @@ def load_config(path: str) -> FmConfig:
             else:
                 kwargs[name] = conv(raw)
 
-    consume("General", _GENERAL_KEYS)
-    consume("Train", _TRAIN_KEYS)
-    consume("Predict", _PREDICT_KEYS)
-    consume("Cluster", _CLUSTER_KEYS)
+    for section, keys in sections.items():
+        consume(section, keys)
     cfg = FmConfig(**kwargs)
     # Reference knobs accepted for config compatibility but with no effect
     # here — tell the user instead of silently ignoring a tuned value.

@@ -125,8 +125,10 @@ def test_unknown_key_fails_loudly(tmp_path):
         [General]
         vocabulary_sizee = 100
     """)
-    with pytest.raises(KeyError):
+    with pytest.raises(KeyError) as err:
         load_config(path)
+    # A true typo must not get a misleading wrong-section hint.
+    assert "belongs in" not in str(err.value)
 
 
 def test_known_key_in_wrong_section_names_its_home(tmp_path):
