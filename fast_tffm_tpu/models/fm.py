@@ -149,7 +149,12 @@ def loss_and_scores(spec: ModelSpec, gathered: jax.Array,
     examples drop out of both value and gradient."""
     scores = _scores(spec, gathered, local_idx, vals, fields, mesh=mesh)
     per = _per_example_loss(spec, scores, labels)
-    wsum = jnp.maximum(weights.sum(), 1.0)
+    # Tiny floor ONLY to keep the all-padding filler batch (sum(w)=0,
+    # numerator 0 — the distributed lockstep's zero-weight filler)
+    # finite; a floor of 1.0 here would silently rescale the loss and
+    # every gradient whenever a batch's total weight lands in (0, 1)
+    # (fractional weight_files), breaking the weighted-mean contract.
+    wsum = jnp.maximum(weights.sum(), 1e-8)
     data_loss = (per * weights).sum() / wsum
     reg = batch_reg(gathered, uniq_ids, spec.vocabulary_size,
                     spec.factor_lambda, spec.bias_lambda)

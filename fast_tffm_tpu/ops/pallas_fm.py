@@ -47,7 +47,12 @@ def _block_b(B: int, K: int, L: int) -> int:
     handful of block-sized temporaries and Mosaic double-buffers blocks
     against the 16 MB scoped-vmem limit."""
     lanes = -(-L // 128) * 128
-    bytes_per_row = max(K, 8) * lanes * 4
+    # K rounds UP to the 8-sublane tile (not max(K, 8)): Mosaic pads the
+    # sublane axis, so e.g. K=9 occupies 16 sublanes — counting 9 would
+    # understate the real block by up to ~78% and blow the budget for
+    # K in 9..15 at large L.
+    sublanes = -(-K // 8) * 8
+    bytes_per_row = sublanes * lanes * 4
     budget = 2 << 20
     b = 1
     while B % (b * 2) == 0 and (b * 2) * bytes_per_row <= budget:

@@ -73,6 +73,17 @@ def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
         raise ValueError(
             f"data axis size {n_data} must be a power of two <= 64 so the "
             "pipeline's power-of-two unique-id buckets shard evenly")
+    # Multi-process: each data-axis row must stay within one process —
+    # global_batch concatenates PER-PROCESS local batches along the data
+    # axis (make_array_from_process_local_data), so a data row spanning
+    # processes would pair different processes' data with one replicated
+    # chunk and offset_local_idx into out-of-range unique slots:
+    # silently corrupted gathers, not an error.
+    if jax.process_count() > 1 and n_data % jax.process_count():
+        raise ValueError(
+            f"data axis size {n_data} must be a multiple of the process "
+            f"count {jax.process_count()}: global_batch assembles one "
+            "data-axis block per process")
     grid = np.asarray(devices).reshape(n_data, model_axis)
     return Mesh(grid, ("data", "model"))
 
@@ -94,8 +105,13 @@ def _require_host_dedup(spec: ModelSpec) -> None:
     trade cheap distributed host CPU for scarce ICI bandwidth."""
     if spec.dedup == "device":
         raise ValueError(
-            "dedup = device is single-device only; mesh paths require "
-            "dedup = host (auto resolves this correctly)")
+            "dedup = device is for the plain single-device jit only; "
+            "mesh steps require dedup = host. The shipped drivers only "
+            "build a mesh when more than one device exists, where "
+            "dedup = auto already resolves to host; when driving the "
+            "mesh API directly on a one-device environment (where auto "
+            "picks device), rebuild the spec with "
+            "dataclasses.replace(spec, dedup='host')")
 
 
 # kernel='pallas' on a mesh: GSPMD has no partitioning rule for a
@@ -253,7 +269,6 @@ def global_batch(mesh: Mesh, local_uniq_size: int, **arrays) -> dict:
     documented multi-host divergence, far smaller than the reference's
     async staleness.
     """
-    import jax
     p = jax.process_index()
     _, vec, mat, _ = _layout(mesh)
     out = {}

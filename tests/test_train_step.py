@@ -102,6 +102,29 @@ def test_zero_weight_examples_do_not_train():
     assert changed.tolist() == [5]
 
 
+def test_fractional_weights_keep_weighted_mean_loss():
+    """A batch whose TOTAL weight is in (0, 1) must still get the
+    weighted-MEAN data loss the docstring promises: the old floor of
+    1.0 on sum(w) silently rescaled loss and gradients by the batch's
+    weight mass for fractional weight_files (review finding). Scaling
+    all weights by a constant must leave the data loss unchanged."""
+    import dataclasses
+    spec = dataclasses.replace(ModelSpec.from_config(CFG),
+                               factor_lambda=0.0, bias_lambda=0.0)
+    block = parse_lines(["1 5:1.0 7:0.5", "0 9:2.0"], V)
+    step = make_train_step(spec)
+    losses = []
+    for scale in (1.0, 0.1):  # sum(w) = 2.0 vs 0.2 (< 1.0)
+        b = make_device_batch(block, CFG)
+        args = batch_args(b)
+        args["weights"] = np.asarray(args["weights"]) * scale
+        # fresh state per call: the step donates table/acc
+        _, _, loss, _ = step(init_table(CFG, seed=4),
+                             init_accumulator(CFG), **args)
+        losses.append(float(loss))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-5)
+
+
 def test_loss_decreases_on_toy_problem():
     rng = np.random.default_rng(0)
     spec = ModelSpec.from_config(CFG)
