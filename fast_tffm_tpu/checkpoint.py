@@ -31,7 +31,8 @@ class CheckpointState:
 
     def save(self, step: int, table: jax.Array, acc: jax.Array,
              vocabulary_size: int, force: bool = False,
-             wait: bool = False) -> None:
+             wait: bool = False, epoch: int = 0,
+             rewrite_stale_metadata: bool = False) -> None:
         """``vocabulary_size`` is stored alongside the arrays: the
         4096-aligned row layout means a changed vocab inside the same
         bucket would otherwise restore shape-compatibly but silently
@@ -46,19 +47,39 @@ class CheckpointState:
         (orbax's own back-pressure), bounding in-flight state to one
         snapshot. ``wait=True`` — the final/preemption save — blocks
         until the bytes are durably committed before returning."""
+        payload = {"table": table, "acc": acc,
+                   "step": np.int64(step),
+                   # COMPLETED epochs at save time: lets a restarted
+                   # run resume an interrupted epoch schedule instead
+                   # of rerunning it from zero (train.resume_start_epoch)
+                   "epoch": np.int64(epoch),
+                   "vocab": np.int64(vocabulary_size)}
         try:
-            self._mngr.save(step,
-                            args=ocp.args.StandardSave(
-                                {"table": table, "acc": acc,
-                                 "step": np.int64(step),
-                                 "vocab": np.int64(vocabulary_size)}),
+            self._mngr.save(step, args=ocp.args.StandardSave(payload),
                             force=force)
         except ocp.checkpoint_manager.StepAlreadyExistsError:
             # The final/preemption save can land on the same step as the
             # last periodic save (save_steps divides the step count).
-            # State at a given step is unique, so this is a no-op — and
-            # orbax's `force` does not cover the already-exists case.
-            pass
+            # The ARRAY state at a given step is unique, so that part is
+            # a no-op — but the colliding periodic save recorded the
+            # epoch count as of MID-epoch, while this save may carry the
+            # completed count; without a rewrite a successfully
+            # completed run restores as "interrupted" and silently
+            # retrains an epoch. The CALLER decides via
+            # rewrite_stale_metadata — train() knows deterministically
+            # (from its own last periodic save) whether the metadata
+            # differs, and a deterministic flag keeps every process of a
+            # multi-host job on the same side of this collective
+            # delete+save (a per-process disk read here could diverge
+            # on one host's transient error and deadlock the final
+            # save). The delete-rewrite window is tolerated: this path
+            # only runs on the final wait=True save, and the
+            # alternative is wrong metadata on every such run.
+            if rewrite_stale_metadata:
+                self._mngr.delete(step)
+                self._mngr.save(step,
+                                args=ocp.args.StandardSave(payload),
+                                force=force)
         if wait:
             self._mngr.wait_until_finished()
 
@@ -82,9 +103,14 @@ class CheckpointState:
         reader = ocp.CheckpointManager(
             self.directory, item_handlers=ocp.PyTreeCheckpointHandler())
         try:
-            return reader.restore(
-                s, args=ocp.args.PyTreeRestore(item=template,
-                                               partial_restore=True))
+            restored, err = _restore_tolerating_legacy_epoch(
+                template,
+                lambda t: reader.restore(
+                    s, args=ocp.args.PyTreeRestore(item=t,
+                                                   partial_restore=True)))
+            if err is not None:
+                raise err
+            return restored
         finally:
             reader.close()
 
@@ -104,30 +130,59 @@ class CheckpointState:
             return None
         if template is None:
             return self._mngr.restore(s)
-        try:
-            return self._mngr.restore(
-                s, args=ocp.args.StandardRestore(template))
-        except (ValueError, KeyError) as e:
-            # Orbax surfaces config-mismatch as a shape ValueError (whose
-            # advice — enable truncation — is wrong here) or, for a
-            # checkpoint predating a template key such as 'vocab', as a
-            # tree-structure error. The same exception classes can also
-            # mean a corrupt/partial step directory (killed writer), so
-            # the advice names both causes rather than steering a user
-            # toward discarding a recoverable checkpoint.
-            raise ValueError(
-                f"checkpoint at {self.directory} step {s} could not be "
-                "restored against this config's layout. Most likely the "
-                "checkpoint was written under a different config "
-                "(vocabulary_size / factor_num / model_type) or an older "
-                "storage layout — fix the config or point model_file at "
-                "the matching checkpoint. If the config is right, this "
-                "step directory may be corrupt/partially written (killed "
-                "save): try an earlier step or delete the bad step dir. "
-                f"Underlying error: {e}") from e
+        restored, err = _restore_tolerating_legacy_epoch(
+            template,
+            lambda t: self._mngr.restore(
+                s, args=ocp.args.StandardRestore(t)))
+        if err is not None:
+            self._raise_restore_error(s, err)
+        return restored
+
+    def _raise_restore_error(self, s, e) -> None:
+        # Orbax surfaces config-mismatch as a shape ValueError (whose
+        # advice — enable truncation — is wrong here) or, for a
+        # checkpoint predating a template key such as 'vocab', as a
+        # tree-structure error. The same exception classes can also
+        # mean a corrupt/partial step directory (killed writer), so
+        # the advice names both causes rather than steering a user
+        # toward discarding a recoverable checkpoint.
+        raise ValueError(
+            f"checkpoint at {self.directory} step {s} could not be "
+            "restored against this config's layout. Most likely the "
+            "checkpoint was written under a different config "
+            "(vocabulary_size / factor_num / model_type) or an older "
+            "storage layout — fix the config or point model_file at "
+            "the matching checkpoint. If the config is right, this "
+            "step directory may be corrupt/partially written (killed "
+            "save): try an earlier step or delete the bad step dir. "
+            f"Underlying error: {e}") from e
 
     def close(self) -> None:
         self._mngr.close()
+
+
+def _restore_tolerating_legacy_epoch(template, do_restore):
+    """Run ``do_restore(template)``; on tree/shape errors retry ONCE
+    without the 'epoch' leaf (checkpoints written before that leaf
+    existed must stay restorable — an upgraded binary has to resume a
+    preempted job's old checkpoint), defaulting the leaf to 0. Returns
+    (restored, None) on success or (None, original_error) when both
+    attempts fail — the caller owns the diagnostic. The one
+    implementation for restore() and restore_partial(); a genuine
+    config mismatch pays one wasted retry on this already-failing
+    path, the price of not needing a metadata side-channel."""
+    try:
+        return do_restore(template), None
+    except (ValueError, KeyError) as e:
+        if "epoch" not in template:
+            return None, e
+        legacy = {k: v for k, v in template.items() if k != "epoch"}
+        try:
+            restored = do_restore(legacy)
+        except (ValueError, KeyError):
+            return None, e
+        restored["epoch"] = 0
+        return restored, None
 
 
 def export_npz(table, path: str,

@@ -207,12 +207,18 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
         multihost_utils.sync_global_devices(f"predict_parts_{tag}")
         if p == 0:
             n = 0
-            with open(out_path, "w") as out_fh:
+            # Stream the merge in bounded chunks: reading a whole part
+            # with fh.read() holds multi-GB strings on the chief for
+            # billion-line predicts.
+            with open(out_path, "wb") as out_fh:
                 for i in range(P):
-                    with open(f"{out_path}.part{i}") as fh:
-                        data = fh.read()
-                    n += data.count("\n")
-                    out_fh.write(data)
+                    with open(f"{out_path}.part{i}", "rb") as fh:
+                        while True:
+                            chunk = fh.read(8 << 20)
+                            if not chunk:
+                                break
+                            n += chunk.count(b"\n")
+                            out_fh.write(chunk)
             logger.info("wrote %d scores to %s (merged %d parts)",
                         n, out_path, P)
         # Chief must finish reading every part before anyone deletes.

@@ -30,7 +30,7 @@ from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
                                      make_train_step, ships_raw_batches)
-from fast_tffm_tpu.utils.fetch import ChunkedFetcher
+from fast_tffm_tpu.utils.fetch import ChunkedFetcher, bulk_fetch
 from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.timing import StepTimer, trace_span
 
@@ -115,14 +115,27 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                                                max_batches=max_batches):
         auc.update(local[:batch.num_real], batch.labels[:batch.num_real])
         n += batch.num_real
-    hists = multihost_utils.process_allgather(
-        np.stack([auc.pos, auc.neg]))          # [P, 2, bins]
-    hists = hists.reshape(-1, 2, auc.num_bins)
-    merged = StreamingAUC(num_bins=auc.num_bins)
-    merged.pos[:] = hists[:, 0, :].sum(axis=0)
-    merged.neg[:] = hists[:, 1, :].sum(axis=0)
-    n_total = int(multihost_utils.process_allgather(
-        np.asarray([n])).sum())
+    # process_allgather device_puts its payload and this runtime never
+    # enables x64, so float64 histograms (and int64 counts) silently
+    # downcast to 32 bits in transit — bins past 2^24 examples lose
+    # integer precision and a per-process n past 2^31 wraps, both real
+    # at the Criteo-1TB north star. Ship every f64 value as a (hi, lo)
+    # float32 pair (lo = v - f64(f32(v))): hi + lo recovers ~48 bits
+    # exactly, enough for any count this side of 10^14.
+    bins = auc.num_bins
+    payload = np.concatenate([auc.pos, auc.neg,
+                              np.asarray([n], np.float64)])
+    hi = payload.astype(np.float32)
+    lo = (payload - hi.astype(np.float64)).astype(np.float32)
+    gathered = multihost_utils.process_allgather(
+        np.stack([hi, lo]))                    # [P, 2, 2*bins+1] f32
+    gathered = gathered.reshape(-1, 2, 2 * bins + 1)
+    vals = (gathered[:, 0, :].astype(np.float64)
+            + gathered[:, 1, :].astype(np.float64)).sum(axis=0)
+    merged = StreamingAUC(num_bins=bins)
+    merged.pos[:] = vals[:bins]
+    merged.neg[:] = vals[bins:2 * bins]
+    n_total = int(round(vals[-1]))
     return merged.result(), n_total
 
 
@@ -204,6 +217,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         check_restored_vocab(cfg, restored)
         global_step = int(restored["step"])
         logger.info("restored checkpoint at step %d", global_step)
+    start_epoch = resume_start_epoch(
+        int(restored["epoch"]) if restored is not None else 0,
+        cfg.epoch_num)
+    if start_epoch:
+        logger.info("resuming interrupted epoch schedule at epoch %d/%d",
+                    start_epoch, cfg.epoch_num)
     lk = None
     if offload:
         # Offload backend (lookup.py; BASELINE config #5): the table/
@@ -250,6 +269,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         if restored is not None:
             table = restored["table"][:cfg.num_rows]
             acc = restored["acc"][:cfg.num_rows]
+            # The slices above are NEW device buffers; drop the full
+            # [ckpt_rows, D] restored arrays so they free once the
+            # slice completes — holding them for the whole run is a
+            # sustained ~2x HBM cost that only bites on resume.
+            restored["table"] = restored["acc"] = None
         else:
             table = init_table(cfg, cfg.seed)
             acc = init_accumulator(cfg)
@@ -372,9 +396,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     def flush_log():
         if not log_buffer:
             return
-        vals = jax.device_get([arr for _, _, arr, _ in log_buffer])
-        for (s, ep, _, eps), v in zip(log_buffer, vals):
-            log_line(s, ep, float(v), eps)
+        # bulk_fetch stacks the same-shaped scalars into ONE transfer:
+        # deferred mode is only ever active on a slow device link,
+        # where a per-element list fetch costs ~200 ms EACH
+        # (utils/fetch.py) — a full 1024-entry buffer would stall for
+        # minutes.
+        bulk_fetch([(arr, (s, ep, eps))
+                    for s, ep, arr, eps in log_buffer],
+                   lambda v, m: log_line(m[0], m[1], float(v), m[2]))
         log_buffer.clear()
     # Handlers stay installed (absorbing re-signals) until the finally
     # below — i.e. until the final checkpoint/export is safely on disk,
@@ -383,7 +412,9 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     # can't leave the surviving process (pytest, REPL, server) with
     # SIGTERM/SIGINT swallowed into a dead flag list.
     try:
-        for epoch in range(cfg.epoch_num):
+        completed_epochs = start_epoch
+        last_periodic_save = (None, None)  # (step, epoch) of the latest
+        for epoch in range(start_epoch, cfg.epoch_num):
             if stopping:
                 break
             epoch_stats = SpillStats()
@@ -461,7 +492,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     # race the in-place numpy Adagrad updates.
                     ckpt.save(global_step, *state,
                               vocabulary_size=cfg.vocabulary_size,
-                              wait=offload)
+                              wait=offload, epoch=completed_epochs)
+                    last_periodic_save = (global_step, completed_epochs)
             flush_log()  # deferred loss lines land at the epoch barrier
             if epoch_stats.spilled_batches or (multi_process
                                                and epoch_stats.batches):
@@ -509,31 +541,42 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     summaries.add("validation/auc", global_step, auc)
             if summaries is not None:  # epoch barrier: bulk-fetch + write
                 summaries.flush()
+            if not stopping:  # a preemption-cut epoch is NOT completed
+                completed_epochs = epoch + 1
         flush_log()
         loss_val = float(loss) if loss is not None else loss_val
         state = lk.state() if offload else ckpt_state(cfg, table, acc)
         # Final/preemption save: barrier until durably written — the
         # process may exit right after.
+        # If the last periodic save landed on this very step with a
+        # stale (mid-epoch) epoch count, tell save() to rewrite it —
+        # a deterministic decision (global_step and completed_epochs
+        # are lockstep-consistent), so every process of a multi-host
+        # job takes the same branch of the collective delete+save.
         ckpt.save(global_step, *state,
                   vocabulary_size=cfg.vocabulary_size, force=True,
-                  wait=True)
+                  wait=True, epoch=completed_epochs,
+                  rewrite_stale_metadata=(
+                      last_periodic_save[0] == global_step
+                      and last_periodic_save[1] != completed_epochs))
         if multi_process:
             _chief_finalize(cfg, table, logger, mesh, shard_index,
                             num_shards, last_val, val_bucket)
-        elif offload:
+        else:
+            # Same size gate on EVERY dense-export path: a single-host
+            # mesh whose aggregate row-sharded table exceeds host RAM
+            # must not OOM assembling the .npz after a successful run.
             nbytes = cfg.num_rows * cfg.row_dim * 4
             if nbytes > EXPORT_NPZ_MAX_BYTES:
                 logger.info(
-                    "skipping dense .npz export: offloaded table is "
+                    "skipping dense .npz export: table is "
                     "%.1f GB > %.1f GB threshold; use the checkpoint at "
                     "%s.ckpt", nbytes / 2**30,
                     EXPORT_NPZ_MAX_BYTES / 2**30, cfg.model_file)
             else:
-                export_npz(lk.table, cfg.model_file + ".npz",
+                export_npz(lk.table if offload else table,
+                           cfg.model_file + ".npz",
                            vocabulary_size=cfg.vocabulary_size)
-        else:
-            export_npz(table, cfg.model_file + ".npz",
-                       vocabulary_size=cfg.vocabulary_size)
     finally:
         try:
             if summaries is not None:
@@ -616,9 +659,13 @@ def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
     from jax.experimental import multihost_utils
     if cfg.validation_files:
         if last_val is None:  # e.g. preemption cut the epoch short
+            # Same cap as the per-epoch sweeps: an uncapped fallback
+            # here would run a full lockstep validation inside a
+            # preemption grace window.
             last_val = evaluate_distributed(
                 cfg, table, cfg.validation_files, mesh, shard_index,
-                num_shards, uniq_bucket=val_bucket)
+                num_shards, uniq_bucket=val_bucket,
+                max_batches=cfg.validation_max_batches or None)
         if jax.process_index() == 0:
             logger.info("final validation AUC %.6f over %d examples",
                         *last_val)
@@ -685,7 +732,7 @@ def checkpoint_template(cfg: FmConfig, mesh=None, host: bool = False):
     if host:
         return {"table": jax.ShapeDtypeStruct(shape, np.float32),
                 "acc": jax.ShapeDtypeStruct(shape, np.float32),
-                "step": 0, "vocab": 0}
+                "step": 0, "epoch": 0, "vocab": 0}
     if mesh is not None:
         from jax.sharding import NamedSharding
         from fast_tffm_tpu.parallel.sharded import ROW_SPEC
@@ -694,7 +741,22 @@ def checkpoint_template(cfg: FmConfig, mesh=None, host: bool = False):
         sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
     return {"table": jax.ShapeDtypeStruct(shape, np.float32, sharding=sh),
             "acc": jax.ShapeDtypeStruct(shape, np.float32, sharding=sh),
-            "step": 0, "vocab": 0}
+            "step": 0, "epoch": 0, "vocab": 0}
+
+
+def resume_start_epoch(stored_epoch: int, epoch_num: int) -> int:
+    """Where a restarted run's epoch loop begins.
+
+    An INTERRUPTED schedule (0 < stored < epoch_num) resumes at the
+    first incomplete epoch — restarting from zero would revisit the
+    same data under the same per-epoch seeds and, under preemptions
+    recurring faster than a full schedule, never terminate. A COMPLETED
+    checkpoint (stored >= epoch_num, or a smaller epoch_num configured
+    since) keeps the reference's semantics: invoking train again runs a
+    fresh epoch_num-epoch schedule on top of the restored weights (the
+    reference's TF1 queue epoch counters were process-local and never
+    checkpointed, so it behaved exactly this way)."""
+    return stored_epoch if 0 < stored_epoch < epoch_num else 0
 
 
 def check_restored_vocab(cfg: FmConfig, restored) -> None:

@@ -55,11 +55,18 @@ class ScalarSummaries:
     def flush(self) -> None:
         if not self._buf:
             return
-        import jax
-        vals = jax.device_get([v for _, _, v in self._buf])
+        # bulk_fetch groups the device scalars into stacked bulk
+        # transfers (a per-element list fetch costs a link round-trip
+        # EACH on slow links — the exact stall the buffering avoids);
+        # python-float values pass through untouched.
+        from fast_tffm_tpu.utils.fetch import bulk_fetch
+        rows = []
+        bulk_fetch([(v, (tag, step)) for tag, step, v in self._buf],
+                   lambda v, meta: rows.append((meta[0], meta[1],
+                                                float(v))))
         with self._writer.as_default():
-            for (tag, step, _), v in zip(self._buf, vals):
-                self._tf.summary.scalar(tag, float(v), step=step)
+            for tag, step, val in rows:
+                self._tf.summary.scalar(tag, val, step=step)
         self._writer.flush()
         self._buf.clear()
 

@@ -100,6 +100,45 @@ def test_resume_from_checkpoint(workdir):
     assert not np.array_equal(npz1, npz2)          # it kept learning
 
 
+def test_interrupted_epoch_schedule_resumes(workdir):
+    """A checkpoint recording an incomplete epoch schedule (epoch <
+    epoch_num — what a preemption save writes) must resume at the first
+    incomplete epoch, not restart the schedule from zero: under
+    recurring preemption a from-zero restart would revisit identical
+    data and never terminate. A COMPLETED checkpoint keeps the
+    reference's train-more semantics (test_resume_from_checkpoint)."""
+    from fast_tffm_tpu.checkpoint import CheckpointState
+    from fast_tffm_tpu.train import train
+    tmp_path, cfg_path, _ = workdir
+    cfg = load_config(str(cfg_path))
+    assert cfg.epoch_num == 8
+
+    # Run the full schedule once, then rewrite the final checkpoint's
+    # metadata to look like a preemption cut it at 5 completed epochs.
+    assert run_tffm.main(["train", str(cfg_path)]) == 0
+    from fast_tffm_tpu.train import checkpoint_template
+    ckpt = CheckpointState(cfg.model_file)
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    steps_full = int(restored["step"])
+    steps_per_epoch = steps_full // cfg.epoch_num
+    # A save at an existing step is a silent no-op (StepAlreadyExists),
+    # so the doctored metadata must land on a NEW step number.
+    doctored = steps_full + 1
+    ckpt.save(doctored, restored["table"], restored["acc"],
+              vocabulary_size=cfg.vocabulary_size, force=True, wait=True,
+              epoch=5)
+    ckpt.close()
+
+    train(cfg)
+    ckpt = CheckpointState(cfg.model_file)
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    ckpt.close()
+    # Only the 3 incomplete epochs ran (not another full 8)...
+    assert int(restored["step"]) == doctored + 3 * steps_per_epoch
+    # ...and the finished schedule is recorded as complete.
+    assert int(restored["epoch"]) == cfg.epoch_num
+
+
 def test_predict_without_checkpoint_fails(tmp_path):
     cfg_path = tmp_path / "p.cfg"
     cfg_path.write_text(textwrap.dedent(f"""
