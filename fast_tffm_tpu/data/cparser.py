@@ -202,6 +202,14 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
     Raises RuntimeError when the extension is unusable, ParseError on
     malformed input."""
     lib = _load()
+    # The output buffers below are sized from len(lines), but the C++
+    # side splits the joined blob on '\n' — an EMBEDDED newline in one
+    # input string would make it emit more examples than allocated
+    # (heap overflow, reproduced as a SIGSEGV). The Python parser
+    # treats '\n' inside a line as plain token whitespace (str.split),
+    # so mapping it to ' ' preserves bit-for-bit parity while keeping
+    # the example count equal to len(lines).
+    lines = [ln.replace("\n", " ") if "\n" in ln else ln for ln in lines]
     blob = "\n".join(lines).encode("utf-8")
     n_lines = len(lines)
     # Worst-case token count bounds the output buffers: a feature token is

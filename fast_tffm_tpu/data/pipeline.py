@@ -420,14 +420,35 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
             start, end = shard_byte_range(path, shard_index, num_shards)
             n_skip = _owned_start_line_index(path, start)
             with open(wpath) as wfh:
-                for _ in range(n_skip):
+                # Weight files are LINE-PARALLEL sidecars; a missing or
+                # blank weight line means the pairing is broken
+                # (truncated copy, corrupted file) and every example
+                # from there on would silently train with the wrong
+                # weight — fail loudly instead of substituting 1.0.
+                for i in range(n_skip):
                     if not wfh.readline():
-                        break
+                        raise ValueError(
+                            f"weight file {wpath} is shorter than its "
+                            f"data file {path}: ended at line {i} while "
+                            f"skipping to this shard's start ({n_skip})")
+                lineno = n_skip
                 for line in _iter_range_lines(path, start, end):
                     wline = wfh.readline()
+                    lineno += 1
+                    if not wline:
+                        raise ValueError(
+                            f"weight file {wpath} is shorter than its "
+                            f"data file {path}: no weight for data "
+                            f"line {lineno}")
                     if not line.strip(WHITESPACE) and not keep_empty:
                         continue
-                    yield line, float(wline) if wline.strip() else 1.0
+                    try:
+                        w = float(wline)
+                    except ValueError:
+                        raise ValueError(
+                            f"bad weight {wline.strip()!r} at {wpath} "
+                            f"line {lineno}") from None
+                    yield line, w
         return
     for path in files:
         start, end = shard_byte_range(path, shard_index, num_shards)
@@ -488,8 +509,14 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                 U = uniq_bucket  # builder guarantees len(uniq) <= U
             else:
                 uladder = _uniq_ladder(B, L)
+                # The builder's uniq already CONTAINS the reserved pad
+                # slot (index 0), unlike the generic path's real-ids-only
+                # set — fitting len+1 here would double-reserve and
+                # inflate U to the next rung exactly at boundaries
+                # (2x gather/scatter width, and a fast-vs-generic shape
+                # divergence that defeats compile-cache reuse).
                 U = (uladder[-1] if fixed_shape
-                     else _ladder_fit(len(uniq) + 1, uladder))
+                     else _ladder_fit(len(uniq), uladder))
             uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
             uniq_ids[:len(uniq)] = uniq  # slot 0 already pad_id (C++)
         weights = np.zeros(B, np.float32)

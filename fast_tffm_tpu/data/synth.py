@@ -113,7 +113,15 @@ def generate(n: int, seed: int, gt: GroundTruth
                   if not miss[i, j]]
         parts += [f"C{f}=v{cat_ids[i, f]}" for f in range(len(CAT_VOCABS))]
         lines.append(" ".join(parts))
-    return lines, labels, logit
+    # Headroom ceiling = the OBSERVED-information logit: the dropped
+    # numeric tokens contributed to the label-generating logit but are
+    # absent from the written files, so a ceiling computed from the
+    # full logit would overstate what any model trained on the files
+    # can reach (part of the gap would be irreducible information
+    # loss, not trainer underperformance). Labels keep the full logit —
+    # the data itself is byte-identical to before.
+    obs_logit = logit - np.where(miss, num_z, 0.0) @ gt.num_w
+    return lines, labels, obs_logit
 
 
 def write_dataset(path_train: str, path_test: str, n_train: int,

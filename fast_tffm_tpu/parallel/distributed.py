@@ -45,11 +45,16 @@ def init_from_cluster(cfg: FmConfig, job_name: str,
                          "'worker' exists in the TPU rebuild (ps roles "
                          "are handled at the CLI)")
     hosts = cfg.worker_hosts
-    if len(hosts) <= 1:
-        return 0, 1
-    if not 0 <= task_index < len(hosts):
+    # Validate BEFORE the single-host early return: a launcher started
+    # with an out-of-range index against a 1-host config would
+    # otherwise be silently accepted as shard 0 of 1 and race the real
+    # worker's checkpoint writes instead of erroring like any
+    # multi-host config does.
+    if not 0 <= task_index < max(len(hosts), 1):
         raise ValueError(f"task_index {task_index} out of range for "
                          f"{len(hosts)} worker_hosts")
+    if len(hosts) <= 1:
+        return 0, 1
     import os
 
     import jax

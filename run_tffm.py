@@ -70,7 +70,15 @@ def main(argv=None) -> int:
     if rest:
         if len(rest) != 3 or rest[0] != "dist_train":
             return _usage()
-        job_name, task_index = rest[1], int(rest[2])
+        job_name = rest[1]
+        try:
+            task_index = int(rest[2])
+        except ValueError:
+            # Same treatment as every other malformed argv form: the
+            # usage text, not a raw int() traceback.
+            print(f"dist_train task index must be an integer, got "
+                  f"{rest[2]!r}", file=sys.stderr)
+            return _usage()
         if job_name == "ps":
             print("fast_tffm_tpu has no parameter servers: the table is "
                   "row-sharded across the device mesh. Launch worker "
