@@ -110,40 +110,50 @@ def regularization(table: np.ndarray, batch: List[Example],
     return float(factor_lambda * np.sum(v * v) + bias_lambda * np.sum(w * w))
 
 
+def _weighted_mean(per: np.ndarray,
+                   weights: np.ndarray | None) -> float:
+    """The trainer's weighted-mean contract (fm.loss_and_scores):
+    sum(per*w)/sum(w), tiny floor only for the all-zero-weight case.
+    Plain mean when no weights — the two coincide at unit weights."""
+    if weights is None:
+        return float(np.mean(per))
+    w = np.asarray(weights, dtype=np.float64)
+    return float((per * w).sum() / max(w.sum(), 1e-8))
+
+
 def logistic_loss(scores: np.ndarray, labels: np.ndarray,
                   weights: np.ndarray | None = None) -> float:
-    """Mean weighted sigmoid cross-entropy with {0,1} labels."""
+    """Weighted-MEAN sigmoid cross-entropy with {0,1} labels (matching
+    the trainer's normalization, not mean-over-batch)."""
     scores = np.asarray(scores, dtype=np.float64)
     labels = np.asarray(labels, dtype=np.float64)
     # log(1 + exp(-yz)) in the stable form used by TF's
     # sigmoid_cross_entropy_with_logits: max(z,0) - z*y + log1p(exp(-|z|))
     per = np.maximum(scores, 0) - scores * labels + np.log1p(
         np.exp(-np.abs(scores)))
-    if weights is not None:
-        per = per * np.asarray(weights, dtype=np.float64)
-    return float(np.mean(per))
+    return _weighted_mean(per, weights)
 
 
 def mse_loss(scores: np.ndarray, labels: np.ndarray,
              weights: np.ndarray | None = None) -> float:
     per = (np.asarray(scores, np.float64) - np.asarray(labels, np.float64)) ** 2
-    if weights is not None:
-        per = per * np.asarray(weights, dtype=np.float64)
-    return float(np.mean(per))
+    return _weighted_mean(per, weights)
 
 
 def grad_fd(table: np.ndarray, batch: List[Example], labels: np.ndarray,
             factor_lambda: float = 0.0, bias_lambda: float = 0.0,
             order: int = 2, loss: str = "logistic",
-            eps: float = 1e-5) -> np.ndarray:
+            eps: float = 1e-5,
+            weights: np.ndarray | None = None) -> np.ndarray:
     """Finite-difference dLoss/dTable over batch-touched rows — the oracle
     for the backward pass (the reference's ``fm_grad``). Dense [V, k+1];
-    rows not touched by the batch are exactly zero."""
+    rows not touched by the batch are exactly zero. ``weights`` rides
+    the loss's weighted-mean normalization (the trainer's contract)."""
     loss_fn = logistic_loss if loss == "logistic" else mse_loss
 
     def total(t):
         s = batch_scores(t, batch, order)
-        return loss_fn(s, labels) + regularization(
+        return loss_fn(s, labels, weights) + regularization(
             t, batch, factor_lambda, bias_lambda)
 
     g = np.zeros_like(table, dtype=np.float64)
@@ -164,7 +174,12 @@ def adagrad_step(table: np.ndarray, acc: np.ndarray, grad: np.ndarray,
                  lr: float) -> Tuple[np.ndarray, np.ndarray]:
     """Reference optimizer: Adagrad with sparse per-row application
     (SURVEY §2 "Loss + optimizer"). Dense oracle form; grad rows of
-    untouched rows are zero so acc/table only change where touched."""
+    untouched rows are zero so acc/table only change where touched —
+    which requires guarding the zero-grad entries: with acc 0 there
+    too, grad/sqrt(acc) is 0/0 = NaN and would poison every untouched
+    row (the trainer never hits this because adagrad_init > 0)."""
     acc = acc + grad * grad
-    table = table - lr * grad / np.sqrt(acc)
+    update = np.divide(grad, np.sqrt(acc),
+                       out=np.zeros_like(grad), where=grad != 0)
+    table = table - lr * update
     return table, acc

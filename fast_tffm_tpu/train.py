@@ -474,14 +474,20 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 timer.tick(batch.num_real * (jax.process_count()
                                              if multi_process else 1))
                 profile_tick(global_step)
-                if cfg.log_steps and global_step % cfg.log_steps == 0:
-                    log_tick(global_step, epoch, loss,
-                             timer.examples_per_sec)
-                if (summaries is not None and global_step
-                        % cfg.save_summaries_steps == 0):
+                log_due = (cfg.log_steps
+                           and global_step % cfg.log_steps == 0)
+                sum_due = (summaries is not None and global_step
+                           % cfg.save_summaries_steps == 0)
+                # One windowed-rate read per step: the read consumes
+                # the window, so the log line and the summary share it.
+                eps_now = (timer.consume_window_rate()
+                           if (log_due or sum_due) else None)
+                if log_due:
+                    log_tick(global_step, epoch, loss, eps_now)
+                if sum_due:
                     summaries.add("train/loss", global_step, loss)
                     summaries.add("train/examples_per_sec", global_step,
-                                  timer.examples_per_sec)
+                                  eps_now)
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
                     state = (lk.state() if offload
                              else ckpt_state(cfg, table, acc))
@@ -600,7 +606,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             for sig, h in prev_handlers.items():
                 signal.signal(sig, h)
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
-                global_step, loss_val, timer.examples_per_sec)
+                global_step, loss_val, timer.total_examples_per_sec)
     ckpt.close()
     if offload:
         # The logical table as host numpy (the offload analogue of the

@@ -20,6 +20,10 @@ def get_logger(name: str = "fast_tffm_tpu",
         h = logging.StreamHandler(sys.stderr)
         h.setFormatter(logging.Formatter(_FMT))
         logger.addHandler(h)
+    if logger.level == logging.NOTSET:
+        # Set the level even when a harness attached its own handler
+        # first: NOTSET resolves through the root logger (WARNING),
+        # which would silently drop every step/loss INFO line.
         logger.setLevel(logging.INFO)
     if log_file:
         have = {getattr(h, "baseFilename", None) for h in logger.handlers}

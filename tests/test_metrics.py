@@ -50,3 +50,23 @@ def test_degenerate_labels():
     a = StreamingAUC()
     a.update(np.array([0.5, 0.7]), np.array([1.0, 1.0]))
     assert np.isnan(a.result())
+
+
+def test_streaming_auc_survives_confident_logits(rng):
+    """Logits far past the sigmoid's resolvable range must still rank:
+    sigmoid binning collapsed everything beyond ~ln(num_bins) (~9.7)
+    into one tie bin, reading AUC ~0.5 for a confidently-separating
+    model (review finding; the arctan squash resolves to |x| ~ 21k)."""
+    scores = rng.normal(40.0, 1.0, size=20000)
+    labels = (rng.random(20000) < 1 / (1 + np.exp(-(scores - 40.0) * 2))
+              ).astype(np.float64)
+    auc = StreamingAUC()
+    auc.update(scores, labels)
+    want = exact_auc(scores, labels)
+    assert abs(auc.result() - want) < 5e-3, (auc.result(), want)
+
+
+def test_streaming_auc_rejects_nan_scores(rng):
+    auc = StreamingAUC()
+    with pytest.raises(ValueError, match="NaN"):
+        auc.update(np.array([0.1, np.nan]), np.array([1.0, 0.0]))
