@@ -583,15 +583,36 @@ def make_offload_train_step(spec, lk, lr: float):
 
 def memory_report() -> dict:
     """Host RSS and device memory stats, for the offload smoke's
-    accounting (tools/offload_smoke.py)."""
+    accounting (tools/offload_smoke.py).
+
+    ``host_rss_mb`` is CURRENT RSS (/proc/self/status VmRSS) — peak
+    RSS is monotone and would bill every freed transient (e.g. the
+    synth corpus) to whatever is measured after it; the lifetime peak
+    is reported separately. Device stats are ``None`` (absent) when
+    the runtime reports none — a 0 here must mean a MEASURED zero, not
+    "couldn't measure" (a leak assert passing on an unmeasured 0 is
+    vacuous)."""
     import resource
-    out = {"host_rss_mb": resource.getrusage(
-        resource.RUSAGE_SELF).ru_maxrss // 1024}
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    rss = peak  # fallback when /proc is unavailable
+    try:
+        with open("/proc/self/status") as fh:
+            for ln in fh:
+                if ln.startswith("VmRSS:"):
+                    rss = int(ln.split()[1]) // 1024
+                    break
+    except OSError:
+        pass
+    out = {"host_rss_mb": rss, "host_peak_rss_mb": peak}
     try:
         import jax
-        stats = jax.local_devices()[0].memory_stats() or {}
-        out["device_in_use_mb"] = stats.get("bytes_in_use", 0) >> 20
-        out["device_limit_mb"] = stats.get("bytes_limit", 0) >> 20
+        stats = jax.local_devices()[0].memory_stats()
     except Exception:
-        pass
+        stats = None
+    def mb(key):  # missing key = UNMEASURED (None), never a fake 0
+        if not stats or key not in stats:
+            return None
+        return stats[key] >> 20
+    out["device_in_use_mb"] = mb("bytes_in_use")
+    out["device_limit_mb"] = mb("bytes_limit")
     return out
