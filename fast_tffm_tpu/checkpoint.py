@@ -76,6 +76,12 @@ class CheckpointState:
             # only runs on the final wait=True save, and the
             # alternative is wrong metadata on every such run.
             if rewrite_stale_metadata:
+                # The colliding periodic save may still be writing
+                # (async); deleting an in-flight step is undefined, so
+                # barrier first. A hard kill inside the delete->resave
+                # window loses this step (an older max_to_keep step
+                # survives) — the tolerance rationale above applies.
+                self._mngr.wait_until_finished()
                 self._mngr.delete(step)
                 self._mngr.save(step,
                                 args=ocp.args.StandardSave(payload),

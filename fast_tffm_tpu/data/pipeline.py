@@ -808,16 +808,20 @@ def _fast_path_eligible(cfg: FmConfig,
 
 def gil_bound_iteration(cfg: FmConfig, weight_files: Sequence[str] = (),
                         keep_empty: bool = False) -> bool:
-    """Whether batch_iterator's parsing for these inputs holds the GIL
-    (pure-Python parser) — the SAME path selection batch_iterator makes
-    (_fast_path_eligible), exposed so prefetch callers can gate the
-    worker thread on it. Python parsing happens when the C++ extension
-    is unavailable, or on the generic path's one parse=None case
-    (keep_empty without the fast path). The generic weighted path
-    block-parses via the C++ parse_lines_fast, which releases the
-    GIL."""
+    """Whether batch_iterator's iteration for these inputs is dominated
+    by GIL-holding Python work — the SAME path selection
+    batch_iterator makes (_fast_path_eligible), exposed so prefetch
+    callers can gate the worker thread on it. That happens when the
+    C++ extension is unavailable, on the generic path's one parse=None
+    case (keep_empty without the fast path), and on the WEIGHTED path:
+    its block parse is C++ (GIL released) but the per-line weight
+    pairing (readline/float/strip and a Python yield per line) holds
+    the GIL — threading it on a single core is the contention class
+    the gate exists to passthrough."""
     from fast_tffm_tpu.data import cparser
     if not cparser.available():
+        return True
+    if weight_files:
         return True
     return (not _fast_path_eligible(cfg, weight_files)) and keep_empty
 

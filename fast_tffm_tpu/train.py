@@ -213,13 +213,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     global_step = 0
     restored = ckpt.restore(
         template=checkpoint_template(cfg, mesh, host=offload))
+    restored_epoch = 0
     if restored is not None:
         check_restored_vocab(cfg, restored)
         global_step = int(restored["step"])
+        restored_epoch = int(restored["epoch"])
         logger.info("restored checkpoint at step %d", global_step)
-    start_epoch = resume_start_epoch(
-        int(restored["epoch"]) if restored is not None else 0,
-        cfg.epoch_num)
+    restored_step = global_step
+    start_epoch = resume_start_epoch(restored_epoch, cfg.epoch_num)
     if start_epoch:
         logger.info("resuming interrupted epoch schedule at epoch %d/%d",
                     start_epoch, cfg.epoch_num)
@@ -554,17 +555,25 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         state = lk.state() if offload else ckpt_state(cfg, table, acc)
         # Final/preemption save: barrier until durably written — the
         # process may exit right after.
-        # If the last periodic save landed on this very step with a
-        # stale (mid-epoch) epoch count, tell save() to rewrite it —
-        # a deterministic decision (global_step and completed_epochs
-        # are lockstep-consistent), so every process of a multi-host
-        # job takes the same branch of the collective delete+save.
+        # If this step's existing checkpoint carries a stale epoch
+        # count — from THIS run's last periodic save, or from the
+        # RESTORED checkpoint when a resumed run advanced the schedule
+        # without a single global step (every shard's input empty —
+        # note a multi-process job with ANY data still advances
+        # global_step via lockstep fillers, so that case needs the
+        # whole job dry) — tell save() to rewrite it. Both signals are
+        # deterministic (lockstep-consistent state, not disk reads), so
+        # every process of a multi-host job takes the same branch of
+        # the collective delete+save.
+        stale = ((last_periodic_save[0] == global_step
+                  and last_periodic_save[1] != completed_epochs)
+                 or (restored is not None
+                     and global_step == restored_step
+                     and completed_epochs != restored_epoch))
         ckpt.save(global_step, *state,
                   vocabulary_size=cfg.vocabulary_size, force=True,
                   wait=True, epoch=completed_epochs,
-                  rewrite_stale_metadata=(
-                      last_periodic_save[0] == global_step
-                      and last_periodic_save[1] != completed_epochs))
+                  rewrite_stale_metadata=stale)
         if multi_process:
             _chief_finalize(cfg, table, logger, mesh, shard_index,
                             num_shards, last_val, val_bucket)
