@@ -57,40 +57,91 @@ class CheckpointState:
         try:
             self._mngr.save(step, args=ocp.args.StandardSave(payload),
                             force=force)
+            # A FRESH save at this step carries authoritative metadata:
+            # drop any leftover same-step sidecar (a cleared-and-reused
+            # directory) and any sidecars orphaned by max_to_keep GC —
+            # CheckpointManager doesn't know about them.
+            if jax.process_index() == 0:
+                self._prune_sidecars(fresh_step=step)
         except ocp.checkpoint_manager.StepAlreadyExistsError:
             # The final/preemption save can land on the same step as the
             # last periodic save (save_steps divides the step count).
             # The ARRAY state at a given step is unique, so that part is
             # a no-op — but the colliding periodic save recorded the
             # epoch count as of MID-epoch, while this save may carry the
-            # completed count; without a rewrite a successfully
+            # completed count; without a correction a successfully
             # completed run restores as "interrupted" and silently
             # retrains an epoch. The CALLER decides via
             # rewrite_stale_metadata — train() knows deterministically
             # (from its own last periodic save) whether the metadata
             # differs, and a deterministic flag keeps every process of a
-            # multi-host job on the same side of this collective
-            # delete+save (a per-process disk read here could diverge
-            # on one host's transient error and deadlock the final
-            # save). The delete-rewrite window is tolerated: this path
-            # only runs on the final wait=True save, and the
-            # alternative is wrong metadata on every such run.
-            if rewrite_stale_metadata:
-                # The colliding periodic save may still be writing
-                # (async); deleting an in-flight step is undefined, so
-                # barrier first. A hard kill inside the delete->resave
-                # window loses this step (an older max_to_keep step
-                # survives) — the tolerance rationale above applies.
-                self._mngr.wait_until_finished()
-                self._mngr.delete(step)
-                self._mngr.save(step,
-                                args=ocp.args.StandardSave(payload),
-                                force=force)
+            # multi-host job on the same side of this path (a
+            # per-process disk read here could diverge on one host's
+            # transient error and deadlock the final save). The
+            # correction is a tiny atomically-renamed sidecar holding
+            # the true epoch — restore() overlays it — NOT a
+            # delete+resave of the step: a hard kill here leaves either
+            # the old sidecar state (epoch stale, exactly the status
+            # quo ante — the run retrains one epoch) or the new one;
+            # the step's arrays are never at risk (advisor finding r4).
+            if rewrite_stale_metadata and jax.process_index() == 0:
+                sc = self._epoch_sidecar(step)
+                tmp = sc + ".tmp"
+                with open(tmp, "w") as fh:
+                    fh.write(str(int(epoch)))
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                os.replace(tmp, sc)
         if wait:
             self._mngr.wait_until_finished()
 
     def wait_until_finished(self) -> None:
         self._mngr.wait_until_finished()
+
+    def _epoch_sidecar(self, step: int) -> str:
+        return os.path.join(self.directory, f"epoch_override-{step}")
+
+    def _prune_sidecars(self, fresh_step: Optional[int] = None) -> None:
+        """Remove epoch sidecars that no longer correct anything: the
+        one for a just-written fresh step, and any whose step orbax GC
+        has deleted. Best-effort — a leftover sidecar costs bytes, a
+        failed prune must not fail a save."""
+        import re
+        kept = set(self._mngr.all_steps())
+        for name in os.listdir(self.directory):
+            m = re.fullmatch(r"epoch_override-(\d+)", name)
+            if not m:
+                continue
+            s = int(m.group(1))
+            if s == fresh_step or s not in kept:
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    def _apply_epoch_override(self, step: int, restored):
+        """Overlay a same-step epoch-correction sidecar (see save())
+        onto a restored tree, when both exist. Multi-process: only
+        process 0 reads the file and the value is broadcast, so a
+        transient read error (or non-shared storage) on one host can
+        never give processes different epochs — divergent resume
+        schedules deadlock the lockstep collectives."""
+        if restored is None or "epoch" not in restored:
+            return restored
+        override = -1
+        if jax.process_index() == 0:
+            try:
+                with open(self._epoch_sidecar(step)) as fh:
+                    override = int(fh.read().strip())
+            except (FileNotFoundError, ValueError):
+                pass  # no/garbled sidecar -> step's own metadata stands
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            override = int(multihost_utils.broadcast_one_to_all(
+                np.int64(override)))
+        if override >= 0:
+            restored["epoch"] = np.int64(override)
+        return restored
 
     def restore_partial(self, template: Dict[str, Any],
                         step: Optional[int] = None
@@ -116,7 +167,7 @@ class CheckpointState:
                                                    partial_restore=True)))
             if err is not None:
                 raise err
-            return restored
+            return self._apply_epoch_override(s, restored)
         finally:
             reader.close()
 
@@ -135,14 +186,14 @@ class CheckpointState:
         if s is None:
             return None
         if template is None:
-            return self._mngr.restore(s)
+            return self._apply_epoch_override(s, self._mngr.restore(s))
         restored, err = _restore_tolerating_legacy_epoch(
             template,
             lambda t: self._mngr.restore(
                 s, args=ocp.args.StandardRestore(t)))
         if err is not None:
             self._raise_restore_error(s, err)
-        return restored
+        return self._apply_epoch_override(s, restored)
 
     def _raise_restore_error(self, s, e) -> None:
         # Orbax surfaces config-mismatch as a shape ValueError (whose

@@ -57,6 +57,11 @@ class FmConfig:
     train_files: Tuple[str, ...] = ()
     weight_files: Tuple[str, ...] = ()
     validation_files: Tuple[str, ...] = ()
+    # Weight sidecars for validation_files (parallel lists, same format
+    # as weight_files). Without this a weighted job trains weighted but
+    # validates unweighted — loss and AUC would disagree about what an
+    # example is worth. Extension knob (the reference has no AUC at all).
+    validation_weight_files: Tuple[str, ...] = ()
     epoch_num: int = 1
     batch_size: int = 1024
     learning_rate: float = 0.01
@@ -176,6 +181,25 @@ class FmConfig:
             raise ValueError(
                 f"uniq_bucket must be 0 (auto) or a power of two >= 64 "
                 f"(mesh sharding divides the unique axis), got {ub}")
+        if self.validation_weight_files and not self.validation_files:
+            raise ValueError(
+                "validation_weight_files given without validation_files")
+        # Sidecar lists must pair 1:1 with their data lists. Globs
+        # expand at iteration time, so an exact config-time length check
+        # is only sound when no entry is a pattern — but that's the
+        # common case, and catching it here beats dying at the first
+        # validation sweep hours into a run.
+        for files, sidecars, name in (
+                (self.train_files, self.weight_files, "weight_files"),
+                (self.validation_files, self.validation_weight_files,
+                 "validation_weight_files")):
+            literal = not any(
+                c in f for f in files + sidecars for c in "*?[")
+            if (sidecars and literal and files
+                    and len(sidecars) != len(files)):
+                raise ValueError(
+                    f"{name} must pair 1:1 with its data files "
+                    f"({len(sidecars)} sidecars vs {len(files)} files)")
         if self.validation_max_batches < 0:
             raise ValueError(
                 f"validation_max_batches must be >= 0 (0 = full sweep), "
@@ -242,6 +266,7 @@ _TRAIN_KEYS = {
     "train_files": _split_files,
     "weight_files": _split_files,
     "validation_files": _split_files,
+    "validation_weight_files": _split_files,
     "epoch_num": int,
     "batch_size": int,
     "learning_rate": float,

@@ -414,8 +414,10 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
     reading and parsing every byte.)"""
     if weight_files:
         if len(weight_files) != len(files):
-            raise ValueError("weight_files must parallel train_files "
-                             f"({len(weight_files)} vs {len(files)})")
+            raise ValueError(
+                "weight sidecar list must pair 1:1 with its data files "
+                f"after glob expansion ({len(weight_files)} sidecars vs "
+                f"{len(files)} files)")
         for path, wpath in zip(files, weight_files):
             start, end = shard_byte_range(path, shard_index, num_shards)
             n_skip = _owned_start_line_index(path, start)
@@ -607,6 +609,11 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
     from fast_tffm_tpu.data.cparser import parse_lines_fast
 
     files = expand_files(files)
+    # Sidecars expand too: pairing is positional AFTER expansion (both
+    # lists sort within each pattern), so parallel naming schemes like
+    # day*.txt / day*.weights pair correctly; the count check in
+    # _iter_lines still catches drifted sets.
+    weight_files = expand_files(weight_files) if weight_files else ()
     B = batch_size or cfg.batch_size
     n_epochs = epochs if epochs is not None else (cfg.epoch_num if training
                                                   else 1)
@@ -692,7 +699,7 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
         for item in _iter_lines(
                 epoch_file_order(files, do_shuffle and not weight_files,
                                  file_seed, epoch),
-                weight_files if training else (),
+                weight_files,
                 shard_index, num_shards, keep_empty=keep_empty):
             if do_shuffle:
                 buf.append(item)

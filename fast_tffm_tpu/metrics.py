@@ -77,24 +77,35 @@ class StreamingAUC:
         self.neg[:] = 0.0
 
 
-def exact_auc(scores: np.ndarray, labels: np.ndarray) -> float:
-    """O(n log n) exact AUC — test oracle for StreamingAUC."""
+def exact_auc(scores: np.ndarray, labels: np.ndarray,
+              weights: np.ndarray | None = None) -> float:
+    """O(n log n) exact AUC — test oracle for StreamingAUC.
+
+    With ``weights``, each (pos, neg) pair contributes w_pos * w_neg
+    (ties half) and the result is pairs / (W_pos * W_neg) — the same
+    statistic StreamingAUC converges to with weighted bin counts.
+    """
     scores = np.asarray(scores, dtype=np.float64).ravel()
     labels = np.asarray(labels, dtype=np.float64).ravel() >= 0.5
+    w = (np.ones_like(scores) if weights is None
+         else np.asarray(weights, dtype=np.float64).ravel())
     order = np.argsort(scores, kind="mergesort")
-    s, y = scores[order], labels[order]
+    s, y, w = scores[order], labels[order], w[order]
     n = len(s)
-    # average ranks with tie handling
-    ranks = np.empty(n, dtype=np.float64)
+    wpos = np.where(y, w, 0.0)
+    wneg = np.where(y, 0.0, w)
+    neg_below = np.cumsum(wneg) - wneg  # strictly-lower negative weight
+    pairs = 0.0
     i = 0
-    while i < n:
+    while i < n:  # tie groups share one (neg_below, group-neg) context
         j = i
         while j + 1 < n and s[j + 1] == s[i]:
             j += 1
-        ranks[i:j + 1] = 0.5 * (i + j) + 1.0
+        g_pos = wpos[i:j + 1].sum()
+        g_neg = wneg[i:j + 1].sum()
+        pairs += g_pos * (neg_below[i] + 0.5 * g_neg)
         i = j + 1
-    n_pos = int(y.sum())
-    n_neg = n - n_pos
-    if n_pos == 0 or n_neg == 0:
+    W_pos, W_neg = wpos.sum(), wneg.sum()
+    if W_pos == 0 or W_neg == 0:
         return float("nan")
-    return float((ranks[y].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+    return float(pairs / (W_pos * W_neg))

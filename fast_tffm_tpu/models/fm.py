@@ -149,13 +149,18 @@ def loss_and_scores(spec: ModelSpec, gathered: jax.Array,
     examples drop out of both value and gradient."""
     scores = _scores(spec, gathered, local_idx, vals, fields, mesh=mesh)
     per = _per_example_loss(spec, scores, labels)
-    # Tiny floor ONLY to keep the all-padding filler batch (sum(w)=0,
-    # numerator 0 — the distributed lockstep's zero-weight filler)
-    # finite; a floor of 1.0 here would silently rescale the loss and
-    # every gradient whenever a batch's total weight lands in (0, 1)
-    # (fractional weight_files), breaking the weighted-mean contract.
-    wsum = jnp.maximum(weights.sum(), 1e-8)
-    data_loss = (per * weights).sum() / wsum
+    # Exact-zero guard ONLY for the all-padding filler batch (sum(w)=0,
+    # numerator 0 — the distributed lockstep's zero-weight filler). Any
+    # nonzero total weight — however tiny (fractional weight_files) —
+    # divides exactly, preserving the weighted-mean contract. DOUBLE
+    # where, not a subnormal floor: TPUs flush f32 subnormals to zero,
+    # so max(0, 1e-38) would still divide 0/0 and divide's VJP would
+    # inject NaN into the table gradient even though the forward value
+    # is masked (and CPU tests can't see it — CPUs keep subnormals).
+    wsum = weights.sum()
+    nonzero = wsum > 0.0
+    den = jnp.where(nonzero, wsum, 1.0)
+    data_loss = jnp.where(nonzero, (per * weights).sum() / den, 0.0)
     reg = batch_reg(gathered, uniq_ids, spec.vocabulary_size,
                     spec.factor_lambda, spec.bias_lambda)
     return data_loss + reg, scores

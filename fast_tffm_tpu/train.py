@@ -50,11 +50,14 @@ LOG_BUFFER_MAX = 1024
 
 def evaluate(cfg: FmConfig, table: jax.Array, files,
              max_batches: Optional[int] = None,
-             mesh=None, backend=None) -> Tuple[float, int]:
+             mesh=None, backend=None,
+             weight_files=()) -> Tuple[float, int]:
     """Streamed AUC over ``files``; returns (auc, n_examples). Pass the
     training mesh to score a row-sharded table in place, or a lookup
     ``backend`` (lookup.HostOffloadLookup) to score a host-offloaded
-    table (``table`` is then unused)."""
+    table (``table`` is then unused). ``weight_files`` (sidecars
+    parallel to ``files``) weight each example's AUC contribution the
+    same way training weights its loss."""
     spec = ModelSpec.from_config(cfg)
     score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
     raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
@@ -64,14 +67,18 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     # Chunked fetches (utils/fetch.py): per-batch syncs are ruinous over
     # a tunnelled link, whole-sweep buffering is unbounded.
     fetcher = ChunkedFetcher(
-        lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]]))
+        lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]],
+                                     m[2][:m[1]]))
     for batch in prefetch(batch_iterator(cfg, files, training=False,
+                                         weight_files=weight_files,
                                          epochs=1, raw_ids=raw),
                           depth=cfg.prefetch_depth,
-                          gil_bound=gil_bound_iteration(cfg)):
+                          gil_bound=gil_bound_iteration(cfg,
+                                                        weight_files)):
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
-        fetcher.add(score_fn(table, args), (batch.labels, batch.num_real))
+        fetcher.add(score_fn(table, args),
+                    (batch.labels, batch.num_real, batch.weights))
         n += batch.num_real
         n_batches += 1
         # Batch-count cap — the same per-input-shard unit the
@@ -85,8 +92,8 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
 def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                          shard_index: int, num_shards: int,
                          uniq_bucket: int = 0,
-                         max_batches: Optional[int] = None
-                         ) -> Tuple[float, int]:
+                         max_batches: Optional[int] = None,
+                         weight_files=()) -> Tuple[float, int]:
     """Multi-process sharded AUC: every process scores its own input
     shard through the mesh score fn in lockstep (the shared
     lockstep_score_batches protocol), then the per-process binned-AUC
@@ -108,12 +115,14 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
     n = 0
     ub = uniq_bucket or cfg.uniq_bucket or probe_uniq_bucket(cfg, files)
     it = batch_iterator(cfg, files, training=False, epochs=1,
+                        weight_files=weight_files,
                         shard_index=shard_index, num_shards=num_shards,
                         fixed_shape=True, uniq_bucket=ub)
     for batch, local in lockstep_score_batches(cfg, it, mesh, score_fn,
                                                table, ub,
                                                max_batches=max_batches):
-        auc.update(local[:batch.num_real], batch.labels[:batch.num_real])
+        nr = batch.num_real
+        auc.update(local[:nr], batch.labels[:nr], batch.weights[:nr])
         n += batch.num_real
     # process_allgather device_puts its payload and this runtime never
     # enables x64, so float64 histograms (and int64 counts) silently
@@ -534,11 +543,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     auc, n = evaluate_distributed(
                         cfg, table, cfg.validation_files, mesh,
                         shard_index, num_shards, uniq_bucket=val_bucket,
-                        max_batches=vmb)
+                        max_batches=vmb,
+                        weight_files=cfg.validation_weight_files)
                 else:
                     auc, n = evaluate(cfg, table, cfg.validation_files,
                                       mesh=mesh, backend=lk,
-                                      max_batches=vmb)
+                                      max_batches=vmb,
+                                      weight_files=(
+                                          cfg.validation_weight_files))
                 last_val = (auc, n)
                 if jax.process_index() == 0:
                     logger.info(
@@ -561,10 +573,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         # without a single global step (every shard's input empty —
         # note a multi-process job with ANY data still advances
         # global_step via lockstep fillers, so that case needs the
-        # whole job dry) — tell save() to rewrite it. Both signals are
-        # deterministic (lockstep-consistent state, not disk reads), so
-        # every process of a multi-host job takes the same branch of
-        # the collective delete+save.
+        # whole job dry) — tell save() to correct it (an atomic epoch
+        # sidecar written by process 0; restore overlays it). Both
+        # signals are deterministic (lockstep-consistent state, not
+        # disk reads), so every process of a multi-host job agrees the
+        # correction exists — restore's process-0-read + broadcast does
+        # the rest.
         stale = ((last_periodic_save[0] == global_step
                   and last_periodic_save[1] != completed_epochs)
                  or (restored is not None
@@ -680,7 +694,8 @@ def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
             last_val = evaluate_distributed(
                 cfg, table, cfg.validation_files, mesh, shard_index,
                 num_shards, uniq_bucket=val_bucket,
-                max_batches=cfg.validation_max_batches or None)
+                max_batches=cfg.validation_max_batches or None,
+                weight_files=cfg.validation_weight_files)
         if jax.process_index() == 0:
             logger.info("final validation AUC %.6f over %d examples",
                         *last_val)

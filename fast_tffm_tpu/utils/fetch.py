@@ -75,8 +75,15 @@ class ChunkedFetcher:
         for idxs in groups.values():
             if len(idxs) > 1:
                 import jax.numpy as jnp
-                host = np.asarray(jax.device_get(
-                    jnp.stack([arrs[i] for i in idxs])))
+                try:
+                    host = np.asarray(jax.device_get(
+                        jnp.stack([arrs[i] for i in idxs])))
+                except (ValueError, TypeError):
+                    # (shape, dtype) grouping can still collide arrays
+                    # on different devices/shardings, which jnp.stack
+                    # rejects; fall back to the per-array list fetch for
+                    # that group rather than fail the whole flush.
+                    continue
                 for i, h in zip(idxs, host):
                     fetched[i] = h
         rest = [i for i in range(len(arrs)) if i not in fetched]

@@ -78,6 +78,45 @@ def test_same_step_resave_updates_stale_epoch(tmp_path):
     ckpt.close()
 
 
+def test_epoch_sidecar_pruned_and_not_leaked(tmp_path):
+    """The stale-epoch correction is a sidecar file, not a delete+resave
+    (advisor r4: a hard kill in that window lost the newest step). It
+    must (a) survive restore, (b) be dropped by a FRESH save at the same
+    step (cleared-and-reused dir), (c) not accumulate once its step is
+    GC'd."""
+    import os
+    cfg = FmConfig(vocabulary_size=1000, factor_num=4,
+                   model_file=str(tmp_path / "m" / "fm"))
+    table, acc = ckpt_state(cfg, init_table(cfg), init_accumulator(cfg))
+    ckpt = CheckpointState(cfg.model_file, max_to_keep=2)
+    ckpt.save(10, table, acc, vocabulary_size=cfg.vocabulary_size,
+              wait=True, epoch=1)
+    ckpt.save(10, table, acc, vocabulary_size=cfg.vocabulary_size,
+              force=True, wait=True, epoch=2,
+              rewrite_stale_metadata=True)
+    sc = ckpt._epoch_sidecar(10)
+    assert os.path.exists(sc)
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    assert int(restored["epoch"]) == 2
+    # steps 20, 30 push step 10 out of max_to_keep=2 -> sidecar pruned
+    ckpt.save(20, table, acc, vocabulary_size=cfg.vocabulary_size,
+              wait=True, epoch=3)
+    ckpt.save(30, table, acc, vocabulary_size=cfg.vocabulary_size,
+              wait=True, epoch=4)
+    assert not os.path.exists(sc)
+    ckpt.close()
+    # cleared-and-reused dir: a stray sidecar must not overlay a fresh
+    # same-step save's metadata
+    ckpt2 = CheckpointState(cfg.model_file)
+    with open(ckpt2._epoch_sidecar(40), "w") as fh:
+        fh.write("99")
+    ckpt2.save(40, table, acc, vocabulary_size=cfg.vocabulary_size,
+               wait=True, epoch=5)
+    restored = ckpt2.restore(template=checkpoint_template(cfg))
+    assert int(restored["epoch"]) == 5
+    ckpt2.close()
+
+
 def test_legacy_checkpoint_without_epoch_leaf_restores(tmp_path):
     """Checkpoints written before the 'epoch' leaf existed must still
     restore (default 0 = no interrupted schedule): an upgraded binary

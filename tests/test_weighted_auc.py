@@ -1,0 +1,179 @@
+"""Weighted validation AUC: training weights its loss by weight_files,
+so validation must weight its AUC the same way (round-4 review: the
+plumbing existed in StreamingAUC but evaluate never passed weights —
+loss and metric disagreed about what an example is worth). The
+reference has no AUC at all (SURVEY.md §5 "Metrics"), so this is a
+within-framework consistency contract, not upstream parity."""
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.metrics import StreamingAUC, exact_auc
+from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_table,
+                                     make_batch_scorer)
+from fast_tffm_tpu.data.pipeline import batch_iterator
+from fast_tffm_tpu.train import evaluate, evaluate_distributed
+
+
+def _brute_auc(scores, labels, weights=None):
+    """O(n^2) pair loop — the definitionally-obvious oracle for the
+    O(n log n) exact_auc."""
+    w = np.ones_like(scores) if weights is None else weights
+    pos = [(s, wi) for s, y, wi in zip(scores, labels, w) if y >= 0.5]
+    neg = [(s, wi) for s, y, wi in zip(scores, labels, w) if y < 0.5]
+    num = sum(wp * wn * (1.0 if sp > sn else 0.5 if sp == sn else 0.0)
+              for sp, wp in pos for sn, wn in neg)
+    den = sum(wp for _, wp in pos) * sum(wn for _, wn in neg)
+    return num / den
+
+
+def test_exact_auc_weighted_matches_brute(rng):
+    scores = rng.normal(size=120).round(1)       # rounding forces ties
+    labels = (rng.uniform(size=120) < 0.5).astype(float)
+    weights = rng.uniform(0.1, 4.0, size=120)
+    assert exact_auc(scores, labels, weights) == pytest.approx(
+        _brute_auc(scores, labels, weights), abs=1e-12)
+    # unweighted path must be unchanged by the weighted generalization
+    assert exact_auc(scores, labels) == pytest.approx(
+        _brute_auc(scores, labels), abs=1e-12)
+
+
+def test_exact_auc_integer_weight_equals_repetition(rng):
+    scores = rng.normal(size=60)
+    labels = (rng.uniform(size=60) < 0.5).astype(float)
+    reps = rng.integers(1, 4, size=60)
+    got = exact_auc(scores, labels, reps.astype(float))
+    want = exact_auc(np.repeat(scores, reps), np.repeat(labels, reps))
+    assert got == pytest.approx(want, abs=1e-12)
+
+
+def test_streaming_weighted_matches_exact(rng):
+    scores = rng.normal(size=4000)
+    labels = (rng.uniform(size=4000) < 0.5).astype(float)
+    weights = rng.uniform(0.1, 5.0, size=4000)
+    auc = StreamingAUC()
+    for i in range(0, 4000, 513):
+        auc.update(scores[i:i + 513], labels[i:i + 513],
+                   weights[i:i + 513])
+    assert auc.result() == pytest.approx(
+        exact_auc(scores, labels, weights), abs=2e-3)
+
+
+def _weighted_eval_setup(tmp_path, rng, n=256):
+    """Dataset + weight sidecar engineered so weighted and unweighted
+    AUC measurably differ: score the (deterministic) init table first,
+    then up-weight the examples the model happens to rank correctly."""
+    vocab = 64
+    lines, labels = [], []
+    for _ in range(n):
+        y = int(rng.integers(0, 2))
+        ids = rng.choice(vocab, size=4, replace=False)
+        toks = " ".join(f"{i}:{round(float(rng.uniform(0.5, 1.5)), 3)}"
+                        for i in sorted(ids))
+        lines.append(f"{y} {toks}\n")
+        labels.append(y)
+    data = tmp_path / "val.txt"
+    data.write_text("".join(lines))
+    cfg = FmConfig(vocabulary_size=vocab, factor_num=4, batch_size=32,
+                   shuffle=False, init_value_range=0.5,
+                   bucket_ladder=(8,), dedup="host",
+                   model_file=str(tmp_path / "m" / "fm"))
+    table = init_table(cfg)
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_batch_scorer(spec)
+    scores = []
+    for b in batch_iterator(cfg, [str(data)], training=False, epochs=1):
+        args = batch_args(b)
+        args.pop("labels"), args.pop("weights")
+        scores.append(np.asarray(score_fn(table, args))[:b.num_real])
+    scores = np.concatenate(scores)
+    labels = np.asarray(labels, dtype=np.float64)
+    med = np.median(scores)
+    weights = np.where((scores > med) == (labels >= 0.5), 5.0, 0.25)
+    wfile = tmp_path / "val.weights.txt"
+    wfile.write_text("".join(f"{w}\n" for w in weights))
+    return cfg, table, str(data), str(wfile), scores, labels, weights
+
+
+def test_evaluate_weighted_sidecar(tmp_path, rng):
+    (cfg, table, data, wfile, scores, labels,
+     weights) = _weighted_eval_setup(tmp_path, rng)
+    auc_u, n_u = evaluate(cfg, table, (data,))
+    auc_w, n_w = evaluate(cfg, table, (data,), weight_files=(wfile,))
+    assert n_u == n_w == len(labels)
+    assert abs(auc_w - auc_u) > 0.02, (
+        "weights constructed to shift AUC had no effect — sidecar not "
+        "reaching StreamingAUC")
+    assert auc_u == pytest.approx(exact_auc(scores, labels), abs=2e-3)
+    assert auc_w == pytest.approx(exact_auc(scores, labels, weights),
+                                  abs=2e-3)
+
+
+def test_evaluate_distributed_weighted(tmp_path, rng):
+    """Same contract through the mesh lockstep + histogram-allgather
+    path (weighted bins ride the (hi, lo) f32 transport unchanged)."""
+    import jax
+    from fast_tffm_tpu.parallel.sharded import make_mesh
+    (cfg, _, data, wfile, scores, labels,
+     weights) = _weighted_eval_setup(tmp_path, rng)
+    mesh = make_mesh(jax.devices()[:8])
+    from fast_tffm_tpu.parallel.sharded import init_sharded_state
+    table, _ = init_sharded_state(cfg, mesh)
+    # re-score through the mesh scorer so the oracle matches this table
+    from fast_tffm_tpu.parallel.sharded import make_sharded_score_fn
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_sharded_score_fn(spec, mesh)
+    auc_w, n = evaluate_distributed(cfg, table, (data,), mesh,
+                                    shard_index=0, num_shards=1,
+                                    weight_files=(wfile,))
+    auc_u, _ = evaluate_distributed(cfg, table, (data,), mesh,
+                                    shard_index=0, num_shards=1)
+    assert n == len(labels)
+    # oracle: score every example through the same mesh fn
+    got = []
+    ub = cfg.uniq_bucket or 0
+    from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
+    ub = ub or probe_uniq_bucket(cfg, (data,))
+    from fast_tffm_tpu.parallel.sharded import lockstep_score_batches
+    it = batch_iterator(cfg, (data,), training=False, epochs=1,
+                        fixed_shape=True, uniq_bucket=ub)
+    ys = []
+    for batch, local in lockstep_score_batches(cfg, it, mesh, score_fn,
+                                               table, ub):
+        got.append(local[:batch.num_real])
+        ys.append(batch.labels[:batch.num_real])
+    got = np.concatenate(got)
+    ys = np.concatenate(ys)
+    # weights were built for the single-device table's scores; rebuild
+    # them for the mesh table's scores by line position (same file)
+    assert auc_u == pytest.approx(exact_auc(got, ys), abs=2e-3)
+    assert auc_w == pytest.approx(exact_auc(got, ys, weights), abs=2e-3)
+    assert abs(auc_w - auc_u) > 1e-6
+
+
+def test_config_validation_weight_files(tmp_path):
+    from fast_tffm_tpu.config import load_config
+    p = tmp_path / "c.cfg"
+    p.write_text("""
+[General]
+vocabulary_size = 100
+[Train]
+train_files = a.txt
+validation_files = v.txt
+validation_weight_files = vw.txt
+""")
+    cfg = load_config(str(p))
+    assert cfg.validation_weight_files == ("vw.txt",)
+    with pytest.raises(ValueError, match="validation_weight_files"):
+        FmConfig(validation_weight_files=("w.txt",))
+    # literal-list length mismatch fails at config time, not hours into
+    # the run at the first validation sweep
+    with pytest.raises(ValueError, match="1:1"):
+        FmConfig(validation_files=("a.txt", "b.txt"),
+                 validation_weight_files=("w.txt",))
+    with pytest.raises(ValueError, match="1:1"):
+        FmConfig(train_files=("a.txt", "b.txt"),
+                 weight_files=("w.txt",))
+    # globbed lists defer to the iteration-time post-expansion check
+    FmConfig(train_files=("shard-*.txt",), weight_files=("w.txt",))
