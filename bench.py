@@ -197,6 +197,16 @@ def synth_ffm_lines(n, vocab, field_num=24, seed=0):
     return lines
 
 
+def ffm_cfg(tmp):
+    from fast_tffm_tpu.config import FmConfig
+    return FmConfig(vocabulary_size=1 << 18, factor_num=4, batch_size=4096,
+                    model_type="ffm", field_num=24, learning_rate=0.05,
+                    factor_lambda=1e-6, bias_lambda=1e-6,
+                    max_features_per_example=32, bucket_ladder=(32,),
+                    train_files=(os.path.join(tmp, "ffm.txt"),),
+                    shuffle=False)
+
+
 def run_ffm_e2e(tmp):
     """FFM end-to-end trials (config #3 shapes), same timing protocol as
     the headline (run_e2e). Returns TRIALS rates: the first full bench
@@ -204,34 +214,32 @@ def run_ffm_e2e(tmp):
     tunnel (order3 138k in-run vs 880-938k re-run in isolation), so
     every e2e line gets the headline's median-of-trials treatment —
     post-compile trials cost ~0.4 s each."""
-    from fast_tffm_tpu.config import FmConfig
     from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
     B_ffm, n_warm, n_timed = 4096, 3, 12
-    path = os.path.join(tmp, "ffm.txt")
-    with open(path, "w") as fh:
+    cfg = ffm_cfg(tmp)
+    with open(cfg.train_files[0], "w") as fh:
         fh.write("\n".join(synth_ffm_lines((n_warm + n_timed) * B_ffm,
                                            1 << 18)) + "\n")
-    cfg = FmConfig(vocabulary_size=1 << 18, factor_num=4, batch_size=B_ffm,
-                   model_type="ffm", field_num=24, learning_rate=0.05,
-                   factor_lambda=1e-6, bias_lambda=1e-6,
-                   max_features_per_example=32, bucket_ladder=(32,),
-                   train_files=(path,), shuffle=False)
     step = make_train_step(ModelSpec.from_config(cfg))
     return [run_e2e(cfg, step, n_warm=n_warm) for _ in range(TRIALS)]
+
+
+def order3_cfg(tmp):
+    from fast_tffm_tpu.config import FmConfig
+    return FmConfig(vocabulary_size=1 << 20, factor_num=8, order=3,
+                    batch_size=4096, learning_rate=0.05,
+                    factor_lambda=1e-6, bias_lambda=1e-6,
+                    max_features_per_example=48, bucket_ladder=(48,),
+                    train_files=(os.path.join(tmp, "train.txt"),),
+                    shuffle=False)
 
 
 def run_order3_e2e(tmp):
     """Order-3 FM end-to-end trials (config #4 shapes), same timing
     protocol and median-of-trials treatment as the headline (see
     run_ffm_e2e on why). Reuses the FM data file already in ``tmp``."""
-    from fast_tffm_tpu.config import FmConfig
     from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
-    cfg = FmConfig(vocabulary_size=1 << 20, factor_num=8, order=3,
-                   batch_size=4096, learning_rate=0.05,
-                   factor_lambda=1e-6, bias_lambda=1e-6,
-                   max_features_per_example=48, bucket_ladder=(48,),
-                   train_files=(os.path.join(tmp, "train.txt"),),
-                   shuffle=False)
+    cfg = order3_cfg(tmp)
     step = make_train_step(ModelSpec.from_config(cfg))
     return [run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS)]
 
@@ -279,28 +287,27 @@ def _enable_compile_cache():
     _enable_compilation_cache()
 
 
-def run_hashed_e2e(train_path):
+def run_hashed_e2e(cfg):
     """Hashed-id FM end-to-end trials: configs #2 (Criteo-1TB) and #5
     (1e9-feature iPinYou) both hash string ids, so the hashed parse +
     murmur path gets its own e2e line (the headline uses plain int ids).
-    Reuses the headline data file — its int ids hash like any string."""
-    import dataclasses
+    Reuses the headline data file — its int ids hash like any string.
+    ``cfg`` comes from _line_cfg so the regime stamp and the measurement
+    cannot diverge."""
     from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
-    cfg = dataclasses.replace(make_cfg(train_path), hash_feature_id=True)
     step = make_train_step(ModelSpec.from_config(cfg))
     return [run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS)]
 
 
-def run_predict_e2e(train_path):
+def run_predict_e2e(cfg):
     """Batch-scoring throughput — the reference's second workload
     (SURVEY §3.4: file -> parse(keep_empty, line-aligned) -> score ->
     ordered scores): examples/sec over full sweeps of the headline file
     through the real predict path (fast_tffm_tpu.predict.predict_scores,
     chunked device fetches included). Sweep 0 pays the compiles and is
-    discarded."""
+    discarded. ``cfg`` comes from _line_cfg (stamp/measurement unity)."""
     from fast_tffm_tpu.models.fm import init_table
     from fast_tffm_tpu.predict import predict_scores
-    cfg = make_cfg(train_path)
     table = init_table(cfg, 0)
     rates = []
     for i in range(TRIALS + 1):
@@ -312,25 +319,69 @@ def run_predict_e2e(train_path):
     return rates
 
 
+def regime_stamp(cfg):
+    """The (L, dedup, kernel) a config's hot loop actually runs —
+    stamped into every bench line so a future reader of BENCH_r0N.json
+    alone can tell WHICH cell of BASELINE.md's kernel/bucket matrix a
+    number is (round-4 review: the bench's hand-tuned L=48 is exactly
+    the cell where the Pallas/XLA winner flips, and the JSON didn't say
+    so). Kernel goes through models.fm.resolved_kernel — the same
+    resolution the traced step uses, so the stamp can't drift from the
+    dispatch."""
+    from fast_tffm_tpu.models.fm import ModelSpec, resolved_kernel
+    spec = ModelSpec.from_config(cfg)
+    L = max(cfg.bucket_ladder)
+    stamp = {"L": L, "dedup": spec.dedup,
+             "kernel": resolved_kernel(spec, L)}
+    if len(cfg.bucket_ladder) > 1:
+        # resolution is per bucket; with a multi-rung ladder a single
+        # (L, kernel) pair would claim a kernel most batches may not
+        # run, so stamp every rung (bench configs today are all
+        # single-rung — this keeps the stamp honest if that changes)
+        stamp["kernel_per_bucket"] = {
+            str(l): resolved_kernel(spec, l) for l in cfg.bucket_ladder}
+    return stamp
+
+
+def _line_cfg(name, train_path):
+    """The config each named line measures — one factory for the line
+    runners AND their regime stamps, so the stamp describes the config
+    that actually ran."""
+    import dataclasses
+    tmp = os.path.dirname(train_path)
+    if name == "ffm":
+        return ffm_cfg(tmp)
+    if name == "order3":
+        return order3_cfg(tmp)
+    if name == "hashed":
+        return dataclasses.replace(make_cfg(train_path),
+                                   hash_feature_id=True)
+    if name == "predict":
+        return make_cfg(train_path)
+    if name == "k16":
+        return dataclasses.replace(make_cfg(train_path), factor_num=16)
+    raise SystemExit(f"unknown bench line {name!r}")
+
+
 def _run_line(name, train_path):
     """One secondary e2e line by name -> its result dict. The single
     dispatch both the subprocess entry and the in-process fallback go
     through, so they cannot drift apart."""
     tmp = os.path.dirname(train_path)
+    cfg = _line_cfg(name, train_path)  # raises on unknown names
+    out = {"regime": regime_stamp(cfg)}
     if name == "ffm":
-        return {"trials": run_ffm_e2e(tmp)}
-    if name == "order3":
-        return {"trials": run_order3_e2e(tmp)}
-    if name == "hashed":
-        return {"trials": run_hashed_e2e(train_path)}
-    if name == "predict":
-        return {"trials": run_predict_e2e(train_path)}
-    if name == "k16":
-        import dataclasses
-        e2e, dev = run_k16(dataclasses.replace(make_cfg(train_path),
-                                               factor_num=16))
-        return {"trials": e2e, "device": dev}
-    raise SystemExit(f"unknown bench line {name!r}")
+        out["trials"] = run_ffm_e2e(tmp)
+    elif name == "order3":
+        out["trials"] = run_order3_e2e(tmp)
+    elif name == "hashed":
+        out["trials"] = run_hashed_e2e(cfg)
+    elif name == "predict":
+        out["trials"] = run_predict_e2e(cfg)
+    else:
+        e2e, dev = run_k16(cfg)
+        out.update(trials=e2e, device=dev)
+    return out
 
 
 def _line_main(name, train_path):
@@ -470,6 +521,15 @@ def main():
         "value": round(eps, 1),
         "unit": "examples/sec",
         "vs_baseline": round(eps / NORTH_STAR_PER_CHIP, 3),
+        # Which cell of BASELINE.md's kernel/bucket matrix the headline
+        # measured (see regime_stamp) — and the same per secondary line
+        # below, so the JSON is self-describing about its regimes.
+        "regime": regime_stamp(cfg),
+        "line_regimes": {"ffm": ffm_res.get("regime"),
+                         "order3": order3_res.get("regime"),
+                         "hashed": hashed_res.get("regime"),
+                         "predict": predict_res.get("regime"),
+                         "k16": k16_res.get("regime")},
         "e2e_trials": [round(v, 1) for v in e2e],
         # BatchBuilder feed parse threads, read from the C++ library (1
         # when the extension is unavailable and the generic Python path

@@ -100,10 +100,12 @@ class FmConfig:
     # training. 0 = auto: measured from the data at startup
     # (data/pipeline.probe_uniq_bucket). Overfull batches spill safely.
     uniq_bucket: int = 0
-    # "auto" = the fused Pallas kernel where it applies (2nd-order FM on
-    # a TPU backend; measured ~3x the XLA step rate at bench shapes,
-    # README "Performance") and XLA everywhere else. Resolved once in
-    # ModelSpec.from_config.
+    # "auto" = the measured regime matrix (ops/kernel_choice.py,
+    # BASELINE.md "Kernel-choice matrix"): the fused Pallas kernel
+    # exactly where it measured faster (2nd-order FM on TPU, device
+    # dedup, bucket width >= 64), XLA everywhere else — resolved per
+    # bucket at trace time. Explicit values always win; re-measure on
+    # new hardware with tools/kernel_probe.py.
     kernel: str = "auto"            # "auto" | "xla" | "pallas"
     # Where the per-batch unique-id pass runs. "host": the pipeline
     # dedups and ships (uniq_ids, local_idx) — required by mesh,

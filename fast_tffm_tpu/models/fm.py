@@ -69,12 +69,17 @@ class ModelSpec:
                 "the XLA scorer instead")
             kernel = "xla"
         if kernel == "auto":
-            # Pallas wherever the fused kernel applies (2nd-order FM) and
-            # the backend can run it natively; interpret mode off-TPU is a
-            # correctness fallback, not a fast path, so auto stays XLA
-            # there.
-            kernel = ("pallas" if cfg.model_type == "fm" and cfg.order == 2
-                      and jax.default_backend() == "tpu" else "xla")
+            # Where the fused Pallas kernel applies (2nd-order FM on a
+            # native-TPU backend), 'auto' SURVIVES into the spec and
+            # _scores resolves it per bucket width at trace time from
+            # the measured (L, dedup) matrix (ops/kernel_choice.py) —
+            # the round-4 always-Pallas policy picked a measured-slower
+            # kernel in half the matrix's cells. Interpret mode off-TPU
+            # is a correctness fallback, not a fast path, so auto
+            # resolves to XLA here.
+            if not (cfg.model_type == "fm" and cfg.order == 2
+                    and jax.default_backend() == "tpu"):
+                kernel = "xla"
         dedup = cfg.dedup
         if dedup == "auto":
             # Device dedup wherever it applies: the plain single-device
@@ -115,6 +120,25 @@ def init_accumulator(cfg: FmConfig) -> jax.Array:
                     dtype=jnp.float32)
 
 
+def resolved_kernel(spec: ModelSpec, L: int) -> str:
+    """The kernel a (spec, bucket-width-L) executable actually runs —
+    the ONE resolution of ``kernel = auto`` (trace-time, per bucket:
+    the bucketed pipeline compiles one executable per (spec, L), so
+    each bucket independently gets the kernel the measured matrix says
+    wins at its width; ops/kernel_choice.py). Shared by _scores and by
+    bench.py's per-line regime stamp so the stamp can't drift from the
+    dispatch."""
+    if spec.model_type == "ffm":
+        return "xla"  # field-bucketed XLA scorer; no Pallas FFM kernel
+    kernel = spec.kernel
+    if kernel == "auto":
+        from fast_tffm_tpu.ops.kernel_choice import auto_kernel
+        kernel = auto_kernel(spec.dedup, L)
+    if kernel == "pallas" and spec.order != 2:
+        kernel = "xla"  # from_config warns; direct specs stay honest
+    return kernel
+
+
 def _scores(spec: ModelSpec, gathered: jax.Array, local_idx: jax.Array,
             vals: jax.Array, fields: Optional[jax.Array],
             mesh=None) -> jax.Array:
@@ -124,7 +148,7 @@ def _scores(spec: ModelSpec, gathered: jax.Array, local_idx: jax.Array,
     if spec.model_type == "ffm":
         return ffm_batch_scores(gathered, spec.field_num, local_idx,
                                 fields, vals)
-    if spec.kernel == "pallas" and spec.order == 2:
+    if resolved_kernel(spec, vals.shape[-1]) == "pallas":
         from fast_tffm_tpu.ops.pallas_fm import fm_batch_scores_pallas
         return fm_batch_scores_pallas(gathered, local_idx, vals, mesh=mesh)
     return fm_batch_scores(gathered, local_idx, vals, order=spec.order)

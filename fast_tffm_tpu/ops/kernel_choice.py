@@ -1,0 +1,37 @@
+"""Regime-aware kernel selection for the 2nd-order FM scorer.
+
+``kernel = auto`` used to resolve unconditionally to the fused Pallas
+kernel on TPU. The measured matrix (BASELINE.md "Kernel-choice matrix",
+same-window interleaved pairs on the real chip, k=8, B=8192) says the
+winner depends on (L, dedup), not the backend alone:
+
+    L   dedup    Pallas  XLA    Pallas/XLA
+    48  device   302M    450M   0.67x
+    48  host     422M    450M   0.94x
+    64  host     360M    413M   0.87x
+    64  device   450M    316M   1.42x
+
+Pallas only wins where the device-side unique pass keeps the batch's
+rows hot in VMEM AND the bucket is at least a full 64-lane tile; every
+host-dedup cell and the sub-tile L=48 cell measured XLA faster (the
+k=16 check at the bench shape agreed: 363M vs 406M). So auto picks
+Pallas exactly in the measured winning regime and XLA elsewhere —
+per BUCKET, at trace time: the bucketed pipeline compiles one
+executable per (spec, L) anyway, so different buckets of one job can
+(correctly) run different kernels.
+
+The matrix is this chip's; on other hardware re-measure with
+``python tools/kernel_probe.py`` (interleaved A/B at your shapes) and,
+if the regime boundary moved, override per job with ``kernel =
+pallas|xla`` — the config knob always beats the matrix.
+"""
+
+from __future__ import annotations
+
+
+def auto_kernel(dedup: str, L: int) -> str:
+    """Resolve ``kernel = auto`` for a 2nd-order FM bucket of width
+    ``L`` under ``dedup`` mode. Callers guarantee model_type=fm,
+    order=2, TPU backend (ModelSpec.from_config keeps 'auto' only
+    there)."""
+    return "pallas" if dedup == "device" and L >= 64 else "xla"

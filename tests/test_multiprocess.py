@@ -145,16 +145,20 @@ worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
     assert np.abs(table).max() > 0.01       # actually trained
 
 
-def _launch_mode(cfg_path, mode):
+def _launch_mode(cfg_path, mode, n_procs: int = 2,
+                 devices_per_proc: int = 1):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
+    if devices_per_proc > 1:
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{devices_per_proc}")
     procs = [
         subprocess.Popen(
             [sys.executable, "run_tffm.py", mode, str(cfg_path),
              "dist_train", "worker", str(i)],
             cwd=REPO, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outs = []
     for p in procs:
@@ -163,6 +167,102 @@ def _launch_mode(cfg_path, mode):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
     return outs
+
+
+@pytest.mark.slow
+def test_four_worker_cluster_lifecycle(tmp_path):
+    """The full job lifecycle at P=4 with REAL transport (round-4
+    review: every protocol beyond P=2 ran only simulated through the
+    dryrun's offset_local_idx math): 4 jax.distributed processes x 2
+    forced CPU devices = an 8-device mesh; train with per-epoch
+    distributed validation, resume onto a larger epoch budget, then
+    4-part multi-process predict merged against a single-process
+    oracle. Line lengths are skewed so the byte-range shards hold
+    different line counts — middle processes (1..2 of 4) run dry at
+    different steps and ride zero-weight lockstep fillers while the
+    others finish, the exact boundary where index/order bugs live."""
+    rng = np.random.default_rng(11)
+    lines = []
+    for i in range(300):
+        # first ~quarter long lines, rest short: 4 equal BYTE ranges
+        # then hold very different LINE counts per shard
+        nnz = int(rng.integers(10, 16)) if i < 75 else int(
+            rng.integers(2, 5))
+        ids = rng.choice(128, size=nnz, replace=False)
+        lines.append(" ".join(["1" if rng.random() < 0.5 else "0"]
+                              + [f"{i}:{rng.random():.3f}" for i in ids]))
+    data = tmp_path / "train.txt"
+    data.write_text("\n".join(lines) + "\n")
+    pred = tmp_path / "pred.txt"
+    pred_lines = lines[:100] + [""] + lines[100:180]  # blank line kept
+    pred.write_text("\n".join(pred_lines) + "\n")
+
+    model = tmp_path / "model" / "fm"
+    coord = _free_port()
+    hosts = ",".join(f"localhost:{coord - 1000 + i}" for i in range(4))
+
+    def write_cfg(epoch_num):
+        (tmp_path / "dist.cfg").write_text(f"""
+[General]
+vocabulary_size = 128
+factor_num = 4
+model_file = {model}
+
+[Train]
+train_files = {data}
+validation_files = {data}
+epoch_num = {epoch_num}
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+max_features_per_example = 16
+bucket_ladder = 16
+
+[Predict]
+predict_files = {pred}
+score_path = {tmp_path}/score
+
+[Cluster]
+worker_hosts = {hosts}
+""")
+
+    cfg = tmp_path / "dist.cfg"
+    write_cfg(epoch_num=2)
+    outs = _launch_mode(cfg, "train", n_procs=4, devices_per_proc=2)
+    assert any("8 devices, 4 processes" in o for o in outs), (
+        outs[0][-2000:])
+    assert any("training done" in o for o in outs)
+    for ep in (0, 1):
+        assert sum(f"epoch {ep} validation AUC" in o for o in outs) == 1
+    assert sum("final validation AUC" in o for o in outs) == 1
+
+    # Resume at P=4: all four processes restore the sharded checkpoint
+    # and continue one more epoch.
+    write_cfg(epoch_num=3)
+    outs2 = _launch_mode(cfg, "train", n_procs=4, devices_per_proc=2)
+    assert all("restored checkpoint at step" in o for o in outs2), (
+        outs2[0][-2000:])
+    assert sum("epoch 2 validation AUC" in o for o in outs2) == 1
+    assert any("training done" in o for o in outs2)
+
+    # 4-part predict: >2 part-file merge order with a blank line in a
+    # middle shard's range.
+    outs3 = _launch_mode(cfg, "predict", n_procs=4, devices_per_proc=2)
+    assert sum("merged 4 parts" in o for o in outs3) == 1, (
+        outs3[0][-2000:])
+    score_file = tmp_path / "score" / "pred.txt.score"
+    scores_mp = np.loadtxt(score_file)
+    assert len(scores_mp) == len(pred_lines)
+    assert not list((tmp_path / "score").glob("*.part*"))
+
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.predict import predict
+    import dataclasses
+    sp_cfg = dataclasses.replace(load_config(str(cfg)),
+                                 score_path=str(tmp_path / "score_sp"))
+    predict(sp_cfg)
+    scores_sp = np.loadtxt(tmp_path / "score_sp" / "pred.txt.score")
+    np.testing.assert_allclose(scores_mp, scores_sp, atol=2e-6)
 
 
 @pytest.mark.slow
