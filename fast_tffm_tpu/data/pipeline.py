@@ -53,13 +53,18 @@ class SpillStats:
     spilled_batches: int = 0    # closed early on the unique-row budget
     real_examples: int = 0      # non-padding examples emitted
     capacity: int = 0           # batches * batch_size
+    max_uniq: int = 0           # densest batch's unique-row count — the
+    # shrink branch of train.adapt_uniq_bucket halves an oversized
+    # bucket only when the whole epoch's densest batch fits the halved
+    # budget with headroom (a mean would hide the one batch that spills)
 
     def count(self, num_real: int, batch_size: int,
-              spilled: bool) -> None:
+              spilled: bool, num_uniq: int = 0) -> None:
         self.batches += 1
         self.spilled_batches += int(spilled)
         self.real_examples += num_real
         self.capacity += batch_size
+        self.max_uniq = max(self.max_uniq, num_uniq)
 
     @property
     def spill_fraction(self) -> float:
@@ -496,7 +501,8 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
     def emit(n, labels, uniq, li, vals, fields, max_nnz,
              spilled: bool = False) -> DeviceBatch:
         if stats is not None:
-            stats.count(n, B, spilled)
+            stats.count(n, B, spilled,
+                        num_uniq=_num_uniq(uniq, cfg.pad_id))
         L = (L_cap if fixed_shape
              else _ladder_fit(max(max_nnz, 1), cfg.bucket_ladder))
         if L < L_cap:
@@ -577,6 +583,20 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                                   max_nnz))
         while window:
             yield window.pop(pyrng.randrange(len(window)))
+
+
+def _num_uniq(uniq_ids, pad_id: int) -> int:
+    """Real unique-row count of a host-deduped uniq array (pad_id slots
+    are fill; no real feature id can equal it). 0 for raw-ids (None).
+    The ONE counting rule for both pipeline paths — the shrink decision
+    in train.adapt_uniq_bucket compares their stats directly."""
+    if uniq_ids is None:
+        return 0
+    return int((uniq_ids != pad_id).sum())
+
+
+def _batch_num_uniq(batch: DeviceBatch, cfg: FmConfig) -> int:
+    return _num_uniq(batch.uniq_ids, cfg.pad_id)
 
 
 def batch_iterator(cfg: FmConfig, files: Sequence[str],
@@ -674,7 +694,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                                             uniq_bucket=uniq_bucket,
                                             raw_ids=raw_ids)
                     if stats is not None:
-                        stats.count(out.num_real, B, False)
+                        stats.count(out.num_real, B, False,
+                                    num_uniq=_batch_num_uniq(out, cfg))
                     yield out
                 except UniqOverflow:
                     # Spill: emit the longest example prefix that fits
@@ -693,7 +714,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                                             fixed_shape=fixed_shape,
                                             uniq_bucket=uniq_bucket)
                     if stats is not None:
-                        stats.count(out.num_real, B, True)
+                        stats.count(out.num_real, B, True,
+                                    num_uniq=_batch_num_uniq(out, cfg))
                     yield out
 
         for item in _iter_lines(

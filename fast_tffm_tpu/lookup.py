@@ -44,6 +44,26 @@ Backends (selected by ``FmConfig.lookup``):
 Storage layout is the checkpoint layout ([ckpt_rows, D], 4096-aligned —
 config.FmConfig.ckpt_rows) so save/restore is allocation-free.
 ``tools/offload_smoke.py`` runs the at-scale accounting check.
+
+**The adapter contract (e.g. a SparseCore backend).** A new storage
+engine plugs in by implementing the three-method seam both existing
+backends share — nothing else in the framework knows where rows live:
+
+- ``gather(uniq_ids) -> [U, D] rows`` (device-consumable; padding
+  slots, ``uniq_ids == pad_id``, may return anything — their gradients
+  come back masked to zero);
+- ``apply_grad(uniq_ids, grad_rows, lr)`` — sparse Adagrad on exactly
+  those rows (duplicate pad slots are zero-gradient no-ops);
+- ``state() -> (table, acc)`` in the [ckpt_rows, D] checkpoint layout,
+  host-fetchable, for CheckpointState save/restore.
+
+Wire-up is two switch points: ``make_offload_backend`` (train) and
+``make_score_backend`` (predict; scoring needs only ``gather`` +
+``table``). In an environment WITH jax-tpu-embedding, a SparseCore
+adapter maps ``gather``/``apply_grad`` onto its embedding-table
+lookup/update primitives and keeps ``state()`` as the HBM/host fetch of
+its shards — the train loop, checkpointing, and predict then work
+unchanged, exactly as they do for the two backends here.
 """
 
 from __future__ import annotations
@@ -407,18 +427,50 @@ class PinnedHostLookup:
             self.table = self._init_big(seed)
         self.acc = self._alloc_full(cfg.adagrad_init)
 
+    # Largest constant-fill HBM temporary we allow: XLA materializes a
+    # jitted full()'s broadcast output in HBM even with pinned
+    # out_shardings (and compute_on doesn't cover constant fills), so a
+    # one-shot alloc caps the state at HBM size — measured failing at
+    # 4e8 rows (25.6 GB broadcast vs 17.2 GB HBM) on the v5e chip.
+    _ALLOC_SLAB_BYTES = 2 << 30
+
     def _alloc_full(self, value: float):
-        """A [ckpt_rows, D] constant array allocated straight into the
-        state placement (no full-size device intermediate)."""
+        """A [ckpt_rows, D] constant array allocated into the state
+        placement. Beyond _ALLOC_SLAB_BYTES (pinned mode), it is built
+        as one HBM-bounded seed slab grown to full size by a HOST-space
+        constant pad — HBM high-water stays one slab and host-memory
+        transient stays ~1x the array (a full-array concatenate would
+        transiently hold 2x, which is exactly what broke the SECOND
+        array's alloc at 4e8 rows with the first one resident)."""
         import jax
         import jax.numpy as jnp
 
+        nbytes = self.rows * self.dim * 4
+        if not self._pinned or nbytes <= self._ALLOC_SLAB_BYTES:
+            @functools.partial(jax.jit, out_shardings=self._s_state)
+            def full():
+                return jnp.full((self.rows, self.dim), np.float32(value),
+                                jnp.float32)
+
+            return full()
+        _, _, ctx = _placement(self._pinned)
+        n_seed = min(self.rows,
+                     self._ALLOC_SLAB_BYTES // (self.dim * 4))
+
         @functools.partial(jax.jit, out_shardings=self._s_state)
-        def full():
-            return jnp.full((self.rows, self.dim), np.float32(value),
+        def seed():
+            return jnp.full((n_seed, self.dim), np.float32(value),
                             jnp.float32)
 
-        return full()
+        @functools.partial(jax.jit, out_shardings=self._s_state)
+        def grow(x):
+            with ctx():
+                return jnp.pad(x, ((0, self.rows - n_seed), (0, 0)),
+                               constant_values=np.float32(value))
+
+        out = grow(seed())
+        out.block_until_ready()  # free the seed slab before returning
+        return out
 
     def _init_big(self, seed: int):
         """Chunked at-scale init: uniform chunks generated ON DEVICE and

@@ -66,7 +66,12 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     # Chunked fetches (utils/fetch.py): per-batch syncs are ruinous over
     # a tunnelled link, whole-file buffering is unbounded.
     out: List[np.ndarray] = []
-    fetcher = ChunkedFetcher(lambda s, num_real: out.append(s[:num_real]))
+    # overlap=True: chunk N's D2H transfer rides a background thread
+    # while this loop dispatches chunk N+1's scoring — without it the
+    # sweep serializes on the fetch (measured: the single dominant cost
+    # of predict_e2e on this link; BASELINE.md "Predict-path rate").
+    fetcher = ChunkedFetcher(lambda s, num_real: out.append(s[:num_real]),
+                             overlap=True)
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1, keep_empty=True,
                                          raw_ids=raw),

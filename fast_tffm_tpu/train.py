@@ -68,7 +68,8 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     # a tunnelled link, whole-sweep buffering is unbounded.
     fetcher = ChunkedFetcher(
         lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]],
-                                     m[2][:m[1]]))
+                                     m[2][:m[1]]),
+        overlap=True)  # D2H of chunk N overlaps scoring of chunk N+1
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          weight_files=weight_files,
                                          epochs=1, raw_ids=raw),
@@ -533,10 +534,13 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 # process applies the same doubling.
                 from jax.experimental import multihost_utils
                 tot = multihost_utils.process_allgather(np.asarray(
-                    [epoch_stats.spilled_batches, epoch_stats.batches]))
-                tot = tot.reshape(-1, 2).sum(axis=0)
+                    [epoch_stats.spilled_batches, epoch_stats.batches,
+                     epoch_stats.max_uniq]))
+                tot = tot.reshape(-1, 3)
                 uniq_bucket = adapt_uniq_bucket(
-                    cfg, uniq_bucket, int(tot[0]), int(tot[1]), logger)
+                    cfg, uniq_bucket, int(tot[:, 0].sum()),
+                    int(tot[:, 1].sum()), logger,
+                    max_uniq=int(tot[:, 2].max()))
             if cfg.validation_files and not stopping:
                 vmb = cfg.validation_max_batches or None
                 if multi_process:
@@ -650,28 +654,54 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
 EXPORT_NPZ_MAX_BYTES = 2 << 30
 
 
+# Shrink threshold: halve the bucket only when the epoch's DENSEST
+# batch used under this fraction of it — the halved bucket then still
+# holds that batch with >= 1/(2*0.35) ~ 1.4x headroom, so the shrink
+# cannot itself cause next-epoch spills on this data.
+SHRINK_FILL_FRACTION = 0.35
+
+
 def adapt_uniq_bucket(cfg: FmConfig, uniq_bucket: int, spilled: int,
-                      batches: int, logger) -> int:
+                      batches: int, logger, max_uniq: int = 0) -> int:
     """Next epoch's fixed unique-row bucket, given THIS epoch's job-wide
-    spill counts: double (up to the worst-case ladder top) while the
-    spill fraction stays above SPILL_WARN_FRACTION. Deterministic in its
-    inputs — callers must feed every process the same totals (train()
-    allgathers them) so all agree on the new batch shapes without
-    negotiation. An explicit ``uniq_bucket`` config is never overridden.
+    stats: double (up to the worst-case ladder top) while the spill
+    fraction stays above SPILL_WARN_FRACTION; halve (never below 64 or
+    the single-example bound) after a spill-free epoch whose densest
+    batch (``max_uniq``, job-wide max) filled under SHRINK_FILL_FRACTION
+    of the bucket — an overshot startup probe or a dense early file
+    otherwise inflates every later step's gather/scatter width for the
+    rest of the job (round-4 review). Deterministic in its inputs —
+    callers must feed every process the same totals (train() allgathers
+    them) so all agree on the new batch shapes without negotiation. An
+    explicit ``uniq_bucket`` config is never overridden.
     """
     if cfg.uniq_bucket or not batches:
         return uniq_bucket
-    if spilled / batches <= SPILL_WARN_FRACTION:
-        return uniq_bucket
-    top = uniq_bucket_top(cfg)
-    if uniq_bucket >= top:
-        return uniq_bucket
-    new_bucket = min(uniq_bucket * 2, top)
-    logger.info(
-        "raising uniq_bucket %d -> %d for the next epoch (%.0f%% of "
-        "batches spilled on the unique-row budget this epoch)",
-        uniq_bucket, new_bucket, 100 * spilled / batches)
-    return new_bucket
+    if spilled / batches > SPILL_WARN_FRACTION:
+        top = uniq_bucket_top(cfg)
+        if uniq_bucket >= top:
+            return uniq_bucket
+        new_bucket = min(uniq_bucket * 2, top)
+        logger.info(
+            "raising uniq_bucket %d -> %d for the next epoch (%.0f%% of "
+            "batches spilled on the unique-row budget this epoch)",
+            uniq_bucket, new_bucket, 100 * spilled / batches)
+        return new_bucket
+    half = uniq_bucket // 2
+    if (spilled == 0 and max_uniq
+            and max_uniq <= uniq_bucket * SHRINK_FILL_FRACTION
+            and half >= 64
+            # config invariant: the bucket must exceed the per-example
+            # feature cap or one dense example could overflow it outright
+            and half > cfg.max_features_per_example):
+        logger.info(
+            "lowering uniq_bucket %d -> %d for the next epoch (densest "
+            "batch used %d unique rows, %.0f%% fill — recovering "
+            "gather/scatter width from an oversized probe or an earlier "
+            "raise)", uniq_bucket, half, max_uniq,
+            100 * max_uniq / uniq_bucket)
+        return half
+    return uniq_bucket
 
 
 def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,

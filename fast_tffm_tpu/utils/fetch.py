@@ -39,23 +39,92 @@ class ChunkedFetcher:
     """``add(device_array, meta)`` accumulates; every ``chunk`` adds (and
     at the final explicit ``flush()``) the pending arrays are fetched in
     ONE ``jax.device_get`` and ``consume(host_array, meta)`` runs for
-    each, in add order."""
+    each, in add order.
+
+    ``overlap=True`` double-buffers: full chunks are handed to ONE
+    background thread that fetches + consumes while the caller keeps
+    dispatching the next chunk's device work — without it the consumer
+    loop stalls for the whole D2H transfer each chunk (the dominant
+    cost of the predict sweep on a tunnelled link, BASELINE.md
+    "Predict-path rate"). The queue holds at most one chunk (a second
+    full chunk blocks the producer), bounding live device arrays to
+    3x chunk (one fetching + one queued + the producer's in-build
+    pending list); ``consume`` then runs on the worker thread, in add
+    order — callers must not read their accumulator state until
+    ``flush()`` returns (both callers aggregate and read only after).
+    Worker exceptions re-raise at the next ``add``/``flush``; the
+    ``flush`` that re-raises also RESETS the fetcher (queued chunks
+    were discarded), so a caller may catch and start a fresh sweep on
+    the same instance."""
 
     def __init__(self, consume: Callable[[np.ndarray, Any], None],
-                 chunk: int = FETCH_CHUNK_BATCHES):
+                 chunk: int = FETCH_CHUNK_BATCHES,
+                 overlap: bool = False):
         self._consume = consume
         self._chunk = chunk
+        self._overlap = overlap
         self._pending: List[Tuple[Any, Any]] = []
+        self._queue = None
+        self._worker = None
+        self._err: List[BaseException] = []
 
     def add(self, arr, meta: Any = None) -> None:
+        self._check_err()
         self._pending.append((arr, meta))
         if len(self._pending) >= self._chunk:
-            self.flush()
+            self._dispatch()
 
-    def flush(self) -> None:
+    def _check_err(self) -> None:
+        if self._err:
+            raise self._err[0]
+
+    def _dispatch(self) -> None:
         if not self._pending:
             return
-        arrs = [a for a, _ in self._pending]
+        batch, self._pending = self._pending, []
+        if not self._overlap:
+            self._fetch_and_consume(batch)
+            return
+        if self._worker is None:
+            import queue
+            import threading
+            self._queue = queue.Queue(maxsize=1)
+            self._worker = threading.Thread(target=self._worker_loop,
+                                            daemon=True)
+            self._worker.start()
+        self._queue.put(batch)  # blocks while the previous chunk fetches
+
+    def _worker_loop(self) -> None:
+        while True:
+            batch = self._queue.get()
+            try:
+                if batch is None:
+                    return
+                if not self._err:  # after an error, drain without work
+                    self._fetch_and_consume(batch)
+            except BaseException as e:  # noqa: BLE001 - re-raised to caller
+                self._err.append(e)
+            finally:
+                self._queue.task_done()
+
+    def flush(self) -> None:
+        """Fetch + consume everything added so far; with overlap, also
+        drains and joins the worker so callers may read their
+        accumulated results after this returns. On a worker error this
+        re-raises it ONCE and leaves the fetcher clean for reuse."""
+        self._dispatch()
+        if self._worker is not None:
+            self._queue.put(None)
+            self._worker.join()
+            self._worker = None
+            self._queue = None
+        if self._err:
+            e = self._err[0]
+            self._err.clear()
+            raise e
+
+    def _fetch_and_consume(self, pending) -> None:
+        arrs = [a for a, _ in pending]
         # device_get on a LIST transfers per-array — N link round-trips.
         # On a proxied device link that multiplies the sweep cost by the
         # chunk arity (measured: a 44-batch predict sweep spent ~9 s in
@@ -90,6 +159,5 @@ class ChunkedFetcher:
         if rest:
             for i, h in zip(rest, jax.device_get([arrs[i] for i in rest])):
                 fetched[i] = h
-        for i, (_, meta) in enumerate(self._pending):
+        for i, (_, meta) in enumerate(pending):
             self._consume(np.asarray(fetched[i]), meta)
-        self._pending.clear()

@@ -175,3 +175,59 @@ def test_chunked_fetcher_stacked_and_mixed_paths():
     f.add(jnp.zeros((5,), jnp.float32), meta="b")
     f.flush()
     assert [(m, arr.shape) for arr, m in got] == [("a", (2,)), ("b", (5,))]
+
+
+def test_chunked_fetcher_overlap_mode():
+    """overlap=True: chunks fetch+consume on a background thread while
+    the producer keeps adding; order, values, and the flush barrier
+    (results fully consumed when flush returns) must all hold, and a
+    consumer exception must surface at flush, not vanish with the
+    thread."""
+    import threading
+
+    import jax.numpy as jnp
+    import pytest
+
+    from fast_tffm_tpu.utils.fetch import ChunkedFetcher
+
+    got = []
+    threads = set()
+
+    def consume(arr, meta):
+        threads.add(threading.current_thread().name)
+        got.append((arr.copy(), meta))
+
+    f = ChunkedFetcher(consume, chunk=4, overlap=True)
+    for i in range(23):
+        f.add(jnp.full((3,), i, dtype=jnp.float32), meta=i)
+    f.flush()
+    assert [m for _, m in got] == list(range(23))
+    for i, (arr, _) in enumerate(got):
+        np.testing.assert_array_equal(arr, np.full((3,), i, np.float32))
+    assert threading.current_thread().name not in threads, (
+        "overlap consume ran on the producer thread")
+    # reusable after flush: the worker restarts on the next add
+    got.clear()
+    f.add(jnp.ones((2,), jnp.float32), meta="z")
+    f.flush()
+    assert [m for _, m in got] == ["z"]
+
+    # consumer exception propagates at flush
+    def boom(arr, meta):
+        raise RuntimeError("consumer exploded")
+
+    g = ChunkedFetcher(boom, chunk=2, overlap=True)
+    g.add(jnp.ones((2,), jnp.float32))
+    g.add(jnp.ones((2,), jnp.float32))
+    with pytest.raises(RuntimeError, match="consumer exploded"):
+        # the error may land on this add or the flush barrier
+        g.add(jnp.ones((2,), jnp.float32))
+        g.add(jnp.ones((2,), jnp.float32))
+        g.flush()
+    # the re-raising flush resets the fetcher; if the error landed on
+    # an add instead, one more flush delivers-and-clears it
+    try:
+        g.flush()
+    except RuntimeError:
+        pass
+    g.flush()  # clean: no stale error poisons reuse

@@ -504,3 +504,56 @@ worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
         assert "raising uniq_bucket 64 -> 128" in out, f"worker {i}"
         assert "raising uniq_bucket 128 -> 256" in out, f"worker {i}"
     assert any("training done" in o for o in outs)
+
+
+@pytest.mark.slow
+def test_two_worker_shrink_oversized_bucket(tmp_path):
+    """The shrink leg of adapt_uniq_bucket at P=2 with real transport:
+    the startup probe's 2x safety margin lands one power of two above
+    what any real batch uses (8 dense lines -> u_max ~132 -> probe
+    rounds 2*132 up to 512, while the epoch's densest batch also needs
+    ~136, a 27% fill), so after a spill-free epoch both workers must
+    halve 512 -> 256 IN LOCKSTEP (a lone shrinker would desynchronize
+    global shapes and deadlock) — and then STOP: at 256 the same batch
+    fills 53%, above the shrink threshold, so the width must not
+    oscillate below what the data needs. This is exactly the ~2x
+    collective-width recovery the round-4 review asked for."""
+    lines = []
+    for i in range(2000):
+        if i < 8:  # one dense batch's worth, inside the probe's head window
+            ids = range(1000 + i * 16, 1000 + (i + 1) * 16)
+            lines.append("1 " + " ".join(f"{j}:1" for j in ids))
+        else:
+            lines.append("0 0:1 1:1 2:1 3:1")
+    data = tmp_path / "train.txt"
+    data.write_text("\n".join(lines) + "\n")
+    coord = _free_port()
+    cfg = tmp_path / "dist.cfg"
+    cfg.write_text(f"""
+[General]
+vocabulary_size = 65536
+factor_num = 2
+model_file = {tmp_path / 'model' / 'fm'}
+
+[Train]
+train_files = {data}
+epoch_num = 3
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+log_steps = 0
+max_features_per_example = 16
+bucket_ladder = 16
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+    outs = _launch(cfg)
+    for i, out in enumerate(outs):
+        assert "fixed unique-row bucket: 512" in out, f"worker {i}"
+        assert "lowering uniq_bucket 512 -> 256" in out, f"worker {i}"
+        assert "lowering uniq_bucket 256 ->" not in out, (
+            f"worker {i} shrank below the data's densest batch")
+        assert "raising uniq_bucket" not in out, (
+            f"worker {i}: the shrink caused spills")
+    assert any("training done" in o for o in outs)

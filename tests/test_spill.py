@@ -148,6 +148,43 @@ def test_adapt_uniq_bucket_raises_on_spill():
                              logger=logger) == 256          # no batches
 
 
+def test_adapt_uniq_bucket_shrinks_on_low_fill():
+    """Shrink branch (round-4 review: the adaptive bucket only grew, so
+    an overshot probe or an early dense file inflated the gather/
+    scatter width for the rest of the job): a spill-free epoch whose
+    densest batch filled < SHRINK_FILL_FRACTION of the bucket halves
+    it — never below 64 or the per-example cap, never when any batch
+    spilled, never against an explicit config."""
+    import logging
+    from fast_tffm_tpu.train import SHRINK_FILL_FRACTION, adapt_uniq_bucket
+    logger = logging.getLogger("test")
+    cfg = FmConfig(vocabulary_size=1 << 16, batch_size=128,
+                   max_features_per_example=16, bucket_ladder=(16,))
+    kw = dict(spilled=0, batches=100, logger=logger)
+    assert adapt_uniq_bucket(cfg, 512, max_uniq=100, **kw) == 256
+    # fill at/above the threshold keeps the width
+    at = int(512 * SHRINK_FILL_FRACTION)
+    assert adapt_uniq_bucket(cfg, 512, max_uniq=at + 1, **kw) == 512
+    # floor: never below 64
+    assert adapt_uniq_bucket(cfg, 64, max_uniq=4, **kw) == 64
+    assert adapt_uniq_bucket(cfg, 128, max_uniq=4, **kw) == 64
+    # floor: the halved bucket must still exceed the per-example cap
+    # (128 -> 64 would leave a full 100-feature example unable to fit)
+    wide = FmConfig(vocabulary_size=1 << 16, batch_size=128,
+                    max_features_per_example=100, bucket_ladder=(128,))
+    assert adapt_uniq_bucket(wide, 128, max_uniq=20, **kw) == 128
+    # any spill this epoch blocks the shrink (densities are recurring)
+    assert adapt_uniq_bucket(cfg, 512, spilled=1, batches=100,
+                             max_uniq=100, logger=logger) == 512
+    # unknown density (max_uniq=0, e.g. no stats) never shrinks
+    assert adapt_uniq_bucket(cfg, 512, max_uniq=0, **kw) == 512
+    # explicit config is never overridden
+    pinned = FmConfig(vocabulary_size=1 << 16, batch_size=128,
+                      max_features_per_example=16, bucket_ladder=(16,),
+                      uniq_bucket=512)
+    assert adapt_uniq_bucket(pinned, 512, max_uniq=100, **kw) == 512
+
+
 def test_adaptive_bucket_clears_spill_by_epoch2(tmp_path):
     """Heterogeneous-density multi-file input where the dense file is
     the MIDDLE one (first+last+largest probe misses it when sizes

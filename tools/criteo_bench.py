@@ -7,8 +7,16 @@ and score-file test AUC, trains the independent NumPy SGD-FM oracle on
 the same data, and prints one JSON blob to record in BASELINE.md.
 
 Usage: python tools/criteo_bench.py [n_train] [n_test]
+       [--seed 17] [--k 8] [--lr 0.05]
+
+``--seed`` regenerates the dataset from a different generative draw and
+``--k/--lr`` move the model to a different operating point — both with
+the oracle re-trained at MATCHED settings, so parity can be pinned at
+more than the single (seed, hyperparameter) pair it was first recorded
+at (round-4 review: one matching pair could be a coincidence).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -21,18 +29,19 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def main(n_train: int = 1_000_000, n_test: int = 100_000) -> None:
+def main(n_train: int = 1_000_000, n_test: int = 100_000,
+         seed: int = 17, k: int = 8, lr: float = 0.05) -> None:
     import run_tffm
     from fast_tffm_tpu.data import synth
     from fast_tffm_tpu.metrics import exact_auc
 
     vocab = 1 << 22
-    k, lr, epochs, lam = 8, 0.05, 2, 1e-6
+    epochs, lam = 2, 1e-6
     with tempfile.TemporaryDirectory() as tmp:
         train = os.path.join(tmp, "train.txt")
         test = os.path.join(tmp, "test.txt")
         t0 = time.time()
-        meta = synth.write_dataset(train, test, n_train, n_test, seed=17)
+        meta = synth.write_dataset(train, test, n_train, n_test, seed=seed)
         gen_sec = time.time() - t0
 
         cfg_path = os.path.join(tmp, "ck.cfg")
@@ -91,6 +100,7 @@ score_path = {tmp}/score
 
     print(json.dumps({
         "config": "baseline#1 criteo-kaggle-like",
+        "seed": seed, "k": k, "lr": lr,
         "n_train": n_train, "n_test": n_test, "epochs": epochs,
         "gen_sec": round(gen_sec, 1),
         "train_sec": round(train_sec, 1),
@@ -105,4 +115,11 @@ score_path = {tmp}/score
 
 
 if __name__ == "__main__":
-    main(*(int(a) for a in sys.argv[1:]))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n_train", type=int, nargs="?", default=1_000_000)
+    ap.add_argument("n_test", type=int, nargs="?", default=100_000)
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.05)
+    a = ap.parse_args()
+    main(a.n_train, a.n_test, seed=a.seed, k=a.k, lr=a.lr)
