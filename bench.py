@@ -33,9 +33,13 @@ between rounds, the same JSON carries the attribution breakdown:
   #2/#5 hash string ids; the headline uses plain int ids),
 - ``predict_e2e``: batch-scoring rate through the real predict path
   (the reference's second workload: parse keep_empty -> score ->
-  ordered scores).
+  ordered scores),
+- ``l64_e2e``: the DEFAULT production regime (auto ladder -> L=64 for
+  Criteo-39 data; kernel auto -> Pallas there) — the headline's
+  hand-tuned L=48 is the XLA cell, so this line both documents the
+  default path and keeps the Pallas kernel exercised end-to-end.
 
-Every e2e line (headline, ffm, order3, hashed, predict, k16) is the median of TRIALS
+Every e2e line (headline, ffm, order3, hashed, predict, k16, l64) is the median of TRIALS
 runs with the per-trial values alongside: a single late-in-the-run
 trial can read 8x low on a tunnelled chip (measured), and the medians
 make that attributable instead of alarming.
@@ -287,6 +291,15 @@ def _enable_compile_cache():
     _enable_compilation_cache()
 
 
+def cfg_e2e_trials(cfg):
+    """TRIALS end-to-end runs of a _line_cfg config through the shared
+    timing protocol — the one body behind every cfg-generic e2e line
+    (hashed, l64), so their protocols cannot drift apart."""
+    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
+    step = make_train_step(ModelSpec.from_config(cfg))
+    return [run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS)]
+
+
 def run_hashed_e2e(cfg):
     """Hashed-id FM end-to-end trials: configs #2 (Criteo-1TB) and #5
     (1e9-feature iPinYou) both hash string ids, so the hashed parse +
@@ -294,9 +307,7 @@ def run_hashed_e2e(cfg):
     Reuses the headline data file — its int ids hash like any string.
     ``cfg`` comes from _line_cfg so the regime stamp and the measurement
     cannot diverge."""
-    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
-    step = make_train_step(ModelSpec.from_config(cfg))
-    return [run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS)]
+    return cfg_e2e_trials(cfg)
 
 
 def run_predict_e2e(cfg):
@@ -360,6 +371,14 @@ def _line_cfg(name, train_path):
         return make_cfg(train_path)
     if name == "k16":
         return dataclasses.replace(make_cfg(train_path), factor_num=16)
+    if name == "l64":
+        # The DEFAULT production regime for Criteo-39 data (auto ladder
+        # lands at L=64; dedup=device on one chip -> kernel auto
+        # resolves to Pallas): the headline's hand-tuned L=48 is the
+        # XLA cell, so without this line the bench would never run the
+        # Pallas path end-to-end (round-4 review weak #6).
+        return dataclasses.replace(make_cfg(train_path),
+                                   bucket_ladder=(64,))
     raise SystemExit(f"unknown bench line {name!r}")
 
 
@@ -378,6 +397,8 @@ def _run_line(name, train_path):
         out["trials"] = run_hashed_e2e(cfg)
     elif name == "predict":
         out["trials"] = run_predict_e2e(cfg)
+    elif name == "l64":
+        out["trials"] = cfg_e2e_trials(cfg)
     else:
         e2e, dev = run_k16(cfg)
         out.update(trials=e2e, device=dev)
@@ -475,6 +496,7 @@ def main():
         hashed_res = _isolated_line("hashed", path)
         predict_res = _isolated_line("predict", path)
         k16_res = _isolated_line("k16", path)
+        l64_res = _isolated_line("l64", path)
 
         cfg = make_cfg(path)
         spec = ModelSpec.from_config(cfg)
@@ -495,7 +517,7 @@ def main():
         # (see _isolated_line).
         for name, res in (("ffm", ffm_res), ("order3", order3_res),
                           ("hashed", hashed_res), ("predict", predict_res),
-                          ("k16", k16_res)):
+                          ("k16", k16_res), ("l64", l64_res)):
             if res["isolation"] == "failed":
                 # A reproducible crash (not a spawn flake) raises here
                 # too — record the null line rather than aborting main()
@@ -511,6 +533,7 @@ def main():
         ffm, order3 = ffm_res["trials"], order3_res["trials"]
         hashed, pred = hashed_res["trials"], predict_res["trials"]
         k16, k16_dev = k16_res["trials"], k16_res["device"]
+        l64 = l64_res["trials"]
 
     def med(trials):  # None survives a timed-out line (see _isolated_line)
         return round(statistics.median(trials), 1) if trials else None
@@ -529,7 +552,8 @@ def main():
                          "order3": order3_res.get("regime"),
                          "hashed": hashed_res.get("regime"),
                          "predict": predict_res.get("regime"),
-                         "k16": k16_res.get("regime")},
+                         "k16": k16_res.get("regime"),
+                         "l64": l64_res.get("regime")},
         "e2e_trials": [round(v, 1) for v in e2e],
         # BatchBuilder feed parse threads, read from the C++ library (1
         # when the extension is unavailable and the generic Python path
@@ -553,6 +577,8 @@ def main():
             [round(v, 1) for v in pred] if pred else None,
         "k16_e2e": med(k16),
         "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
+        "l64_e2e": med(l64),
+        "l64_e2e_trials": [round(v, 1) for v in l64] if l64 else None,
         "k16_device_pallas": round(k16_dev["pallas"], 1) if k16_dev
         else None,
         "k16_device_xla": round(k16_dev["xla"], 1) if k16_dev else None,
@@ -564,7 +590,8 @@ def main():
                            "order3": order3_res["isolation"],
                            "hashed": hashed_res["isolation"],
                            "predict": predict_res["isolation"],
-                           "k16": k16_res["isolation"]},
+                           "k16": k16_res["isolation"],
+                           "l64": l64_res["isolation"]},
     }))
 
 
