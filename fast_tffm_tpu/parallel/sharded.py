@@ -314,6 +314,14 @@ def local_rows(global_arr: jax.Array) -> np.ndarray:
     return np.concatenate(pieces)
 
 
+# Batches agreed on per lockstep round: one flag allgather (a
+# synchronizing host collective) covers this many score programs, and
+# their device->host score fetches defer to the round's end so fetch i
+# overlaps programs i+1.. still in flight. Device cost per round is
+# WINDOW batches' args + [B_global] score vectors in flight (a few MB).
+LOCKSTEP_WINDOW = 8
+
+
 def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
                            table, uniq_bucket: int,
                            max_batches: Optional[int] = None):
@@ -324,35 +332,65 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     ``(batch, local_scores)`` per local iterator batch — the single
     implementation of the deadlock-sensitive protocol shared by
     distributed validation and multi-process predict (a diverging copy
-    here hangs a cluster, not a test)."""
+    here hangs a cluster, not a test).
+
+    Round-5 windowing: processes agree once per LOCKSTEP_WINDOW batches
+    (an allgather of per-process window fill) instead of once per batch
+    — every round each process runs max(fills) collective programs,
+    padding its own tail with fillers, so programs stay matched while
+    the per-batch host-sync collective and the per-batch blocking score
+    fetch both amortize across the window."""
     from jax.experimental import multihost_utils
     from fast_tffm_tpu.data.pipeline import empty_batch
     from fast_tffm_tpu.models.fm import batch_args
     n_real = 0
+    filler = None
+    filler_gargs = None  # device assembly of the all-padding batch is
+    # identical every filler step — ship it once, not once per step
+    # (H2D is the documented bottleneck on a tunnelled chip)
     while True:
-        done = bool(max_batches and n_real >= max_batches)
-        batch = None if done else next(it, None)
-        flags = multihost_utils.process_allgather(
-            np.asarray([batch is None]))
-        if bool(flags.all()):
+        window = []
+        while len(window) < LOCKSTEP_WINDOW:
+            if max_batches and n_real + len(window) >= max_batches:
+                break
+            b = next(it, None)
+            if b is None:
+                break
+            window.append(b)
+        fills = multihost_utils.process_allgather(
+            np.asarray([len(window)]))
+        rounds = int(fills.max())
+        if rounds == 0:
             return
-        filler = batch is None
-        if filler:
-            batch = empty_batch(cfg, uniq_bucket=uniq_bucket)
-        else:
-            n_real += 1
-        args = batch_args(batch)
-        args.pop("labels"), args.pop("weights")
-        gargs = global_batch(mesh, len(batch.uniq_ids), **args)
-        # This process's rows of the global [B_global] score vector are
-        # exactly its local batch (global_batch concatenates local
-        # batches in process order over process-contiguous data-axis
-        # devices); local_rows dedups model-axis replicas.
-        local = local_rows(score_fn(table, **gargs))
-        assert len(local) == len(batch.labels), (
-            f"local score slice {len(local)} != local batch "
-            f"{len(batch.labels)}")
-        yield batch, local
+        pending = []
+        for i in range(rounds):
+            if i < len(window):
+                batch = window[i]
+                args = batch_args(batch)
+                args.pop("labels"), args.pop("weights")
+                gargs = global_batch(mesh, len(batch.uniq_ids), **args)
+            else:
+                if filler_gargs is None:
+                    filler = empty_batch(cfg, uniq_bucket=uniq_bucket)
+                    args = batch_args(filler)
+                    args.pop("labels"), args.pop("weights")
+                    filler_gargs = global_batch(
+                        mesh, len(filler.uniq_ids), **args)
+                gargs = filler_gargs
+            score = score_fn(table, **gargs)
+            if i < len(window):
+                pending.append((batch, score))
+        n_real += len(window)
+        for batch, score in pending:
+            # This process's rows of the global [B_global] score vector
+            # are exactly its local batch (global_batch concatenates
+            # local batches in process order over process-contiguous
+            # data-axis devices); local_rows dedups model-axis replicas.
+            local = local_rows(score)
+            assert len(local) == len(batch.labels), (
+                f"local score slice {len(local)} != local batch "
+                f"{len(batch.labels)}")
+            yield batch, local
 
 
 def shard_batch(mesh: Mesh, **arrays) -> dict:

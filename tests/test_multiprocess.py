@@ -391,6 +391,77 @@ worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
 
 
 @pytest.mark.slow
+def test_two_worker_weighted_validation(tmp_path):
+    """validation_weight_files through the REAL multi-process path:
+    sidecar byte-range sharding, weights into the lockstep scorer's
+    StreamingAUC, weighted bins over the (hi,lo)-f32 histogram
+    allgather. The weighted AUC (logged once, by the chief, from the
+    merged job-wide histograms — cross-worker value agreement is
+    pinned in-process by test_evaluate_distributed_weighted) must
+    differ from the unweighted run's on weights built to move the
+    rank statistic."""
+    import re
+    rng = np.random.default_rng(21)
+    lines = []
+    for _ in range(240):
+        nnz = rng.integers(2, 10)
+        ids = rng.choice(128, size=nnz, replace=False)
+        lines.append(" ".join(["1" if rng.random() < 0.5 else "0"]
+                              + [f"{i}:{rng.random():.3f}" for i in ids]))
+    data = tmp_path / "train.txt"
+    data.write_text("\n".join(lines) + "\n")
+    # Weights must vary WITHIN a class: class-constant weights scale
+    # every (pos, neg) pair uniformly and cancel in the normalized rank
+    # statistic (weighted AUC == unweighted, exactly). Heavy-tailed
+    # per-line weights concentrate the statistic on a few examples, so
+    # it provably moves at this sample size.
+    weights = np.exp(rng.normal(0.0, 2.0, size=len(lines)))
+    wfile = tmp_path / "val.w"
+    wfile.write_text("".join(f"{w:.6f}\n" for w in weights))
+
+    def write_cfg(extra):
+        coord = _free_port()
+        (tmp_path / "dist.cfg").write_text(f"""
+[General]
+vocabulary_size = 128
+factor_num = 4
+model_file = {tmp_path / 'model' / 'fm'}
+
+[Train]
+train_files = {data}
+validation_files = {data}
+{extra}
+epoch_num = 1
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+max_features_per_example = 16
+bucket_ladder = 16
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+
+    cfg = tmp_path / "dist.cfg"
+
+    def final_auc(outs):
+        vals = set()
+        for out in outs:
+            vals.update(re.findall(
+                r"epoch 0 validation AUC (\d+\.\d+)", out))
+        assert len(vals) == 1, vals  # exactly one (chief-logged) value
+        return float(vals.pop())
+
+    write_cfg("")
+    auc_u = final_auc(_launch_mode(cfg, "train"))
+    import shutil
+    shutil.rmtree(tmp_path / "model")
+    write_cfg(f"validation_weight_files = {wfile}")
+    auc_w = final_auc(_launch_mode(cfg, "train"))
+    assert abs(auc_w - auc_u) > 0.005, (auc_u, auc_w)
+
+
+@pytest.mark.slow
 def test_two_process_adagrad_convergence_parity(tmp_path):
     """The documented multi-process Adagrad divergence (an id hot on
     several processes accumulates sum-of-per-process g^2 instead of
