@@ -339,18 +339,38 @@ def regime_stamp(cfg):
     so). Kernel goes through models.fm.resolved_kernel — the same
     resolution the traced step uses, so the stamp can't drift from the
     dispatch."""
+    from fast_tffm_tpu.data.pipeline import effective_L_cap
     from fast_tffm_tpu.models.fm import ModelSpec, resolved_kernel
     spec = ModelSpec.from_config(cfg)
-    L = max(cfg.bucket_ladder)
+    if cfg.max_features_per_example == 0:
+        # Unlimited features: the generic path extends buckets per
+        # BATCH, so the widest width (and auto's kernel there) is
+        # data-dependent — stamping the ladder top would claim a
+        # kernel the widest batches may not run.
+        return {"L": None, "dedup": spec.dedup,
+                "kernel": (spec.kernel if spec.kernel != "auto"
+                           else None),
+                "note": ("max_features_per_example=0: bucket width "
+                         "(and auto kernel resolution) are "
+                         "data-dependent")}
+    # The widest bucket a job can RUN is effective_L_cap, not the
+    # ladder top: max_features_per_example past the ladder extends it
+    # with pow2 rungs, and that extended rung is exactly where the
+    # auto kernel can differ.
+    rungs = [l for l in cfg.bucket_ladder]
+    cap = effective_L_cap(cfg)
+    if cap > rungs[-1]:
+        rungs.append(cap)
+    L = rungs[-1]
     stamp = {"L": L, "dedup": spec.dedup,
              "kernel": resolved_kernel(spec, L)}
-    if len(cfg.bucket_ladder) > 1:
-        # resolution is per bucket; with a multi-rung ladder a single
+    if len(rungs) > 1:
+        # resolution is per bucket; with several rungs a single
         # (L, kernel) pair would claim a kernel most batches may not
         # run, so stamp every rung (bench configs today are all
         # single-rung — this keeps the stamp honest if that changes)
         stamp["kernel_per_bucket"] = {
-            str(l): resolved_kernel(spec, l) for l in cfg.bucket_ladder}
+            str(l): resolved_kernel(spec, l) for l in rungs}
     return stamp
 
 

@@ -102,13 +102,29 @@ class CheckpointState:
         return os.path.join(self.directory, f"epoch_override-{step}")
 
     def _prune_sidecars(self, fresh_step: Optional[int] = None) -> None:
-        """Remove epoch sidecars that no longer correct anything: the
-        one for a just-written fresh step, and any whose step orbax GC
-        has deleted. Best-effort — a leftover sidecar costs bytes, a
-        failed prune must not fail a save."""
+        """Remove epoch sidecars that no longer correct anything.
+
+        Two legs with DIFFERENT failure contracts: removing the
+        fresh-step's stale sidecar is correctness-bearing (a survivor
+        would overlay the wrong epoch on the step just written —
+        cleared-and-reused dir case), so anything but "not there"
+        raises and fails the save loudly; the orphan scan for
+        GC-deleted steps is purely cosmetic (a leftover orphan costs
+        bytes and can never overlay: its step no longer restores), so
+        no flake in listdir/all_steps may fail an already-committed
+        save."""
         import re
-        kept = set(self._mngr.all_steps())
-        for name in os.listdir(self.directory):
+        if fresh_step is not None:
+            try:
+                os.remove(self._epoch_sidecar(fresh_step))
+            except FileNotFoundError:
+                pass  # the common case: nothing to correct
+        try:
+            kept = set(self._mngr.all_steps())
+            names = os.listdir(self.directory)
+        except Exception:  # noqa: BLE001 - cosmetic scan only
+            return
+        for name in names:
             m = re.fullmatch(r"epoch_override-(\d+)", name)
             if not m:
                 continue

@@ -20,6 +20,13 @@ per BUCKET, at trace time: the bucketed pipeline compiles one
 executable per (spec, L) anyway, so different buckets of one job can
 (correctly) run different kernels.
 
+Consequence worth stating: mesh and multi-process paths REQUIRE host
+dedup, so under auto they always resolve to XLA (the matrix's two
+host-dedup cells both measured XLA faster). That cell pair was
+measured single-chip — the sharded-assembly regime itself has no
+direct measurement — so a cluster operator who measures otherwise can
+still force ``kernel = pallas`` (it runs under shard_map).
+
 The matrix is this chip's; on other hardware re-measure with
 ``python tools/kernel_probe.py`` (interleaved A/B at your shapes) and,
 if the regime boundary moved, override per job with ``kernel =

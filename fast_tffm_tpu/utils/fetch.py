@@ -69,14 +69,15 @@ class ChunkedFetcher:
         self._err: List[BaseException] = []
 
     def add(self, arr, meta: Any = None) -> None:
-        self._check_err()
+        if self._err:
+            # Deliver the worker's error through the same drain + join +
+            # clear path flush uses — raising here directly would leave
+            # the worker parked on its queue forever and the error
+            # sticky, breaking the documented reset-for-reuse contract.
+            self.flush()
         self._pending.append((arr, meta))
         if len(self._pending) >= self._chunk:
             self._dispatch()
-
-    def _check_err(self) -> None:
-        if self._err:
-            raise self._err[0]
 
     def _dispatch(self) -> None:
         if not self._pending:

@@ -160,8 +160,11 @@ log_steps = 0
     ckpt_dir = str(model) + ".ckpt"
     try:
         # Kill the instant a later step starts appearing: step N's async
-        # write is then likely mid-flight.
-        deadline = time.time() + 120
+        # write is then likely mid-flight. Generous deadline: the child
+        # pays interpreter + jax + jit-compile startup (~25 s idle, a
+        # multiple of that when the 1-core host is already loaded —
+        # observed flaking at 120 s under a concurrent suite).
+        deadline = time.time() + 300
         while time.time() < deadline:
             steps = [d for d in (os.listdir(ckpt_dir)
                                  if os.path.isdir(ckpt_dir) else [])
