@@ -344,23 +344,26 @@ def regime_stamp(cfg):
     spec = ModelSpec.from_config(cfg)
     if cfg.max_features_per_example == 0:
         # Unlimited features: the generic path extends buckets per
-        # BATCH, so the widest width (and auto's kernel there) is
-        # data-dependent — stamping the ladder top would claim a
-        # kernel the widest batches may not run.
-        return {"L": None, "dedup": spec.dedup,
-                "kernel": (spec.kernel if spec.kernel != "auto"
-                           else None),
+        # BATCH, so the widest width is data-dependent. auto's kernel
+        # is only L-dependent under DEVICE dedup — for host dedup the
+        # matrix resolves to xla at every width, so stamp that
+        # deterministically rather than an uninformative null.
+        kern = spec.kernel
+        if kern == "auto":
+            kern = None if spec.dedup == "device" else "xla"
+        return {"L": None, "dedup": spec.dedup, "kernel": kern,
                 "note": ("max_features_per_example=0: bucket width "
-                         "(and auto kernel resolution) are "
-                         "data-dependent")}
+                         "is data-dependent"
+                         + ("" if kern else "; so is auto's kernel "
+                            "under device dedup"))}
     # The widest bucket a job can RUN is effective_L_cap, not the
     # ladder top: max_features_per_example past the ladder extends it
-    # with pow2 rungs, and that extended rung is exactly where the
-    # auto kernel can differ.
+    # by DOUBLING rungs, and batches land per their own width — so
+    # stamp every extended rung, not just the cap.
     rungs = [l for l in cfg.bucket_ladder]
     cap = effective_L_cap(cfg)
-    if cap > rungs[-1]:
-        rungs.append(cap)
+    while rungs[-1] < cap:
+        rungs.append(rungs[-1] * 2)
     L = rungs[-1]
     stamp = {"L": L, "dedup": spec.dedup,
              "kernel": resolved_kernel(spec, L)}

@@ -1,0 +1,101 @@
+"""Edge cases of the windowed lockstep scoring protocol
+(parallel/sharded.lockstep_score_batches) — the deadlock-sensitive
+loop shared by distributed validation and multi-process predict. Real
+transport is covered at P=2/P=4 in test_multiprocess.py; these pin the
+window-boundary arithmetic (empty iterators, max_batches at/over/under
+the window size, multi-window sweeps) single-process on the fake
+8-device mesh, where a miscount shows up as a wrong yield count or
+score mismatch instead of a cluster hang."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import batch_iterator, probe_uniq_bucket
+from fast_tffm_tpu.models.fm import ModelSpec
+from fast_tffm_tpu.parallel import sharded
+from fast_tffm_tpu.parallel.sharded import (init_sharded_state,
+                                            lockstep_score_batches,
+                                            make_mesh,
+                                            make_sharded_score_fn)
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("lockstep")
+    rng = np.random.default_rng(3)
+    lines = []
+    for _ in range(23 * 16):  # 23 batches at B=16: crosses 2 windows
+        ids = rng.choice(64, size=int(rng.integers(2, 6)), replace=False)
+        lines.append(" ".join(["1" if rng.random() < 0.5 else "0"]
+                              + [f"{i}:1" for i in sorted(ids)]))
+    data = tmp / "d.txt"
+    data.write_text("\n".join(lines) + "\n")
+    cfg = FmConfig(vocabulary_size=64, factor_num=4, batch_size=16,
+                   shuffle=False, bucket_ladder=(8,), dedup="host",
+                   model_file=str(tmp / "m" / "fm"))
+    mesh = make_mesh(jax.devices()[:8])
+    table, _ = init_sharded_state(cfg, mesh)
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_sharded_score_fn(spec, mesh)
+    ub = probe_uniq_bucket(cfg, [str(data)])
+    return cfg, mesh, table, score_fn, str(data), ub
+
+
+def _sweep(rig_t, max_batches=None):
+    cfg, mesh, table, score_fn, data, ub = rig_t
+    it = batch_iterator(cfg, [data], training=False, epochs=1,
+                        fixed_shape=True, uniq_bucket=ub)
+    out = []
+    for batch, local in lockstep_score_batches(cfg, it, mesh, score_fn,
+                                               table, ub,
+                                               max_batches=max_batches):
+        assert batch.num_real > 0  # fillers are never yielded
+        out.append((batch, local[:batch.num_real]))
+    return out
+
+
+def test_multi_window_sweep_scores_everything(rig):
+    out = _sweep(rig)
+    assert len(out) == 23  # 2 full windows + a 7-batch tail
+    assert sum(b.num_real for b, _ in out) == 23 * 16
+    # scores match a direct (non-lockstep) mesh scoring of each batch
+    cfg, mesh, table, score_fn, data, ub = rig
+    from fast_tffm_tpu.models.fm import batch_args
+    from fast_tffm_tpu.parallel.sharded import global_batch, local_rows
+    it = batch_iterator(cfg, [data], training=False, epochs=1,
+                        fixed_shape=True, uniq_bucket=ub)
+    for (batch, local), ref_batch in zip(out, it):
+        args = batch_args(ref_batch)
+        args.pop("labels"), args.pop("weights")
+        gargs = global_batch(mesh, len(ref_batch.uniq_ids), **args)
+        want = local_rows(score_fn(table, **gargs))
+        np.testing.assert_allclose(local, want[:ref_batch.num_real],
+                                   rtol=1e-6)
+
+
+@pytest.mark.parametrize("cap", [
+    1,                                # far below the window
+    sharded.LOCKSTEP_WINDOW,          # exactly one window
+    sharded.LOCKSTEP_WINDOW + 3,      # mid-second-window
+    2 * sharded.LOCKSTEP_WINDOW,      # exact multiple
+    1000,                             # cap above the data
+])
+def test_max_batches_boundaries(rig, cap):
+    # the contract: every real batch up to the cap, regardless of how
+    # the cap aligns with LOCKSTEP_WINDOW (expectation derived, so the
+    # test survives a retuned window constant)
+    assert len(_sweep(rig, max_batches=cap)) == min(cap, 23)
+
+
+def test_empty_iterator_yields_nothing(rig):
+    cfg, mesh, table, score_fn, _, ub = rig
+    for batch, local in lockstep_score_batches(cfg, iter(()), mesh,
+                                               score_fn, table, ub):
+        raise AssertionError("empty iterator must not yield")
+
+
+def test_window_constant_is_sane():
+    assert sharded.LOCKSTEP_WINDOW >= 2
