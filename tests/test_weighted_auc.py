@@ -60,10 +60,8 @@ def test_streaming_weighted_matches_exact(rng):
         exact_auc(scores, labels, weights), abs=2e-3)
 
 
-def _weighted_eval_setup(tmp_path, rng, n=256):
-    """Dataset + weight sidecar engineered so weighted and unweighted
-    AUC measurably differ: score the (deterministic) init table first,
-    then up-weight the examples the model happens to rank correctly."""
+def _weighted_eval_data(tmp_path, rng, n):
+    """Dataset + deterministic table only — no scoring pass."""
     vocab = 64
     lines, labels = [], []
     for _ in range(n):
@@ -79,7 +77,14 @@ def _weighted_eval_setup(tmp_path, rng, n=256):
                    shuffle=False, init_value_range=0.5,
                    bucket_ladder=(8,), dedup="host",
                    model_file=str(tmp_path / "m" / "fm"))
-    table = init_table(cfg)
+    return cfg, init_table(cfg), data, np.asarray(labels, np.float64)
+
+
+def _weighted_eval_setup(tmp_path, rng, n=256):
+    """Dataset + weight sidecar engineered so weighted and unweighted
+    AUC measurably differ: score the (deterministic) init table first,
+    then up-weight the examples the model happens to rank correctly."""
+    cfg, table, data, labels = _weighted_eval_data(tmp_path, rng, n)
     spec = ModelSpec.from_config(cfg)
     score_fn = make_batch_scorer(spec)
     scores = []
@@ -150,6 +155,19 @@ def test_evaluate_distributed_weighted(tmp_path, rng):
     assert auc_u == pytest.approx(exact_auc(got, ys), abs=2e-3)
     assert auc_w == pytest.approx(exact_auc(got, ys, weights), abs=2e-3)
     assert abs(auc_w - auc_u) > 1e-6
+
+
+def test_evaluate_surfaces_divergence_through_overlap(tmp_path, rng):
+    """A diverged model (NaN scores) must still raise StreamingAUC's
+    diagnostic out of evaluate() — the round-5 overlap moved consume
+    onto a background thread, and a swallowed error there would turn
+    'model diverged' into a silently-wrong AUC."""
+    cfg, table, data, _ = _weighted_eval_data(tmp_path, rng, n=64)
+    import jax.numpy as jnp
+    bad = jnp.asarray(np.full(np.asarray(table).shape, np.nan,
+                              np.float32))
+    with pytest.raises(ValueError, match="NaN"):
+        evaluate(cfg, bad, (str(data),))
 
 
 def test_config_validation_weight_files(tmp_path):
