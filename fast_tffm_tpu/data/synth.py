@@ -224,6 +224,181 @@ def numpy_fm_train_predict(train_blocks, test_blocks, vocab: int, k: int,
     return np.concatenate(scores)
 
 
+# ---------------------------------------------------------------------------
+# Field-aware (FFM) twin: Avazu-like data with a KNOWN field-aware
+# generative model, plus an independent NumPy FFM-SGD oracle — the
+# config-#3 analogue of the FM pair above. One categorical id per field
+# per example (Avazu's shape), ids offset into disjoint per-field ranges
+# of one vocabulary space (the framework's single-table FFM layout).
+# ---------------------------------------------------------------------------
+
+FFM_FIELDS: Tuple[int, ...] = (40, 3000, 25000, 15, 400, 9000, 3,
+                               1200, 60000, 25, 5000, 150)
+# Cumulative per-field offsets keep ids disjoint in ONE compact vocab
+# (Σ field vocabs ~104k rows) instead of fixed power-of-two strides
+# whose table would be ~87% dead rows — the framework and the oracle
+# both size their tables from ffm_vocab_size().
+FFM_FIELD_OFFSETS: Tuple[int, ...] = tuple(
+    int(x) for x in np.concatenate([[0], np.cumsum(FFM_FIELDS)[:-1]]))
+FFM_PAIR_RANK = 3
+FFM_N_PAIRS = 20
+
+
+def ffm_vocab_size() -> int:
+    return int(sum(FFM_FIELDS))
+
+
+def _make_ffm_truth(seed: int):
+    rng = np.random.default_rng(seed)
+    F = len(FFM_FIELDS)
+    main = [rng.normal(0.0, 0.4, size=v) for v in FFM_FIELDS]
+    chosen = set()
+    while len(chosen) < FFM_N_PAIRS:
+        f, g = sorted(rng.choice(F, size=2, replace=False))
+        chosen.add((int(f), int(g)))
+    pairs = {(f, g): (rng.normal(0.0, 0.4, size=(FFM_FIELDS[f],
+                                                 FFM_PAIR_RANK)),
+                      rng.normal(0.0, 0.4, size=(FFM_FIELDS[g],
+                                                 FFM_PAIR_RANK)))
+             for f, g in chosen}
+    return main, pairs
+
+
+def _ffm_generate(n: int, seed: int, truth):
+    main, pairs = truth
+    rng = np.random.default_rng(seed)
+    F = len(FFM_FIELDS)
+    ids = np.stack([(rng.zipf(ZIPF_A, size=n) - 1) % v
+                    for v in FFM_FIELDS], axis=1)       # [n, F]
+    logit = np.full(n, -1.2)
+    for f in range(F):
+        logit += main[f][ids[:, f]]
+    for (f, g), (u, v) in pairs.items():
+        logit += np.einsum("nr,nr->n", u[ids[:, f]], v[ids[:, g]])
+    labels = (rng.random(n) < 1.0 / (1.0 + np.exp(-logit))).astype(
+        np.int32)
+    lines = [" ".join([str(labels[i])]
+                      + [f"{f}:{FFM_FIELD_OFFSETS[f] + ids[i, f]}"
+                         for f in range(F)])
+             for i in range(n)]
+    return lines, labels, logit, ids
+
+
+def write_ffm_dataset(path_train: str, path_test: str, n_train: int,
+                      n_test: int, seed: int = 0) -> dict:
+    """Write field-aware train/test files (`f:id` tokens, one id per
+    field); returns metadata incl. the Bayes-ceiling AUC."""
+    from fast_tffm_tpu.metrics import exact_auc
+    truth = _make_ffm_truth(seed)
+    train_lines, train_y, _, _ = _ffm_generate(n_train, seed + 1, truth)
+    test_lines, test_y, test_logit, _ = _ffm_generate(n_test, seed + 2,
+                                                      truth)
+    with open(path_train, "w") as fh:
+        fh.write("\n".join(train_lines) + "\n")
+    with open(path_test, "w") as fh:
+        fh.write("\n".join(test_lines) + "\n")
+    return {"n_train": n_train, "n_test": n_test,
+            "positive_rate_train": float(train_y.mean()),
+            "positive_rate_test": float(test_y.mean()),
+            "bayes_auc": exact_auc(test_logit, test_y)}
+
+
+def parse_ffm_file(path: str, batch_size: int):
+    """[B, F] global-id batches + labels, parsed directly from `f:id`
+    lines — the oracle's OWN reader (independence from the framework's
+    parser; golden parity for that parser is tested separately)."""
+    F = len(FFM_FIELDS)
+    batches = []
+    ids_buf, y_buf = [], []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            toks = line.split()
+            if not toks:
+                continue
+            y_buf.append(float(toks[0]))
+            row = np.full(F, -1, np.int64)  # -1 = field unseen: a
+            # truncated/duplicated line must fail loudly below, not
+            # index the weight table with uninitialized memory
+            for t in toks[1:]:
+                f, i = t.split(":")
+                row[int(f)] = int(i)
+            if (row < 0).any():
+                raise ValueError(
+                    f"{path}:{lineno}: expected one token per field "
+                    f"(fields {np.flatnonzero(row < 0).tolist()} "
+                    "missing)")
+            ids_buf.append(row)
+            if len(ids_buf) == batch_size:
+                batches.append((np.stack(ids_buf),
+                                np.asarray(y_buf)))
+                ids_buf, y_buf = [], []
+    if ids_buf:
+        batches.append((np.stack(ids_buf), np.asarray(y_buf)))
+    return batches
+
+
+def numpy_ffm_train_predict(train_batches, test_batches, vocab: int,
+                            k: int, lr: float, epochs: int,
+                            factor_lambda: float, bias_lambda: float,
+                            init_range: float = 0.01,
+                            adagrad_init: float = 0.1,
+                            seed: int = 7) -> np.ndarray:
+    """Independent field-aware FM oracle, hand-derived gradients.
+
+    Row layout [vocab+1, F*k + 1]: v[id, g*k:(g+1)*k] is id's latent
+    toward TARGET field g, last column the linear weight (the
+    framework's documented FFM layout, but the math here is written
+    from the FFM definition, not from ops/interaction.py):
+        score = Σ_f w[id_f] + Σ_{f<g} <v[id_f,:,g], v[id_g,:,f]>
+        d score / d v[id_f, :, g] = v[id_g, :, f]   (and symmetric)
+        d score / d w[id_f]      = 1
+    Minibatch mean logistic gradient + batch-active L2 + Adagrad —
+    the same update semantics as numpy_fm_train_predict.
+    """
+    F = len(FFM_FIELDS)
+    D = F * k + 1
+    rng = np.random.default_rng(seed)
+    W = rng.uniform(-init_range, init_range, size=(vocab + 1, D))
+    acc = np.full((vocab + 1, D), adagrad_init)
+
+    def batch_scores(ids, Wm):
+        rows = Wm[ids]                              # [B, F, D]
+        v = rows[..., :F * k].reshape(len(ids), F, F, k)
+        score = rows[..., -1].sum(axis=1)
+        for f in range(F):
+            for g in range(f + 1, F):
+                score += (v[:, f, g] * v[:, g, f]).sum(axis=1)
+        return score, v
+
+    for _ in range(epochs):
+        for ids, y in train_batches:
+            B = len(y)
+            score, v = batch_scores(ids, W)
+            p = 1.0 / (1.0 + np.exp(-score))
+            gl = (p - y) / B                        # [B]
+            grad = np.zeros((B, F, D))
+            for f in range(F):
+                for g in range(F):
+                    if f == g:
+                        continue
+                    # d score/d v[id_f, :, g] = v[id_g, :, f]
+                    grad[:, f, g * k:(g + 1) * k] = (
+                        gl[:, None] * v[:, g, f])
+                grad[:, f, -1] = gl
+            uniq, inv = np.unique(ids, return_inverse=True)
+            grows = np.zeros((len(uniq), D))
+            np.add.at(grows, inv.ravel(), grad.reshape(-1, D))
+            grows[:, :F * k] += 2.0 * factor_lambda * W[uniq, :F * k]
+            grows[:, -1] += 2.0 * bias_lambda * W[uniq, -1]
+            acc[uniq] += np.square(grows)
+            W[uniq] -= lr * grows / np.sqrt(acc[uniq])
+
+    out = []
+    for ids, _ in test_batches:
+        out.append(batch_scores(ids, W)[0])
+    return np.concatenate(out)
+
+
 def parse_file_blocks(path: str, vocab: int, batch_size: int):
     """Parse a libsvm file into CSR blocks via the (golden-tested) fast
     parser — the shared input both trainers consume."""

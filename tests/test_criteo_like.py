@@ -85,3 +85,62 @@ score_path = {tmp}/score
     # And both genuinely learned (ceiling is meta["bayes_auc"] ~0.90).
     assert fw_auc > 0.72, fw_auc
     assert fw_auc < meta["bayes_auc"]
+
+
+@pytest.mark.slow
+def test_avazu_like_ffm_auc_parity(tmp_path):
+    """BASELINE config #3's parity leg: field-aware data from a KNOWN
+    field-aware generative model, the real CLI FFM train->predict vs
+    the independent NumPy FFM-SGD oracle (synth.numpy_ffm_train_predict
+    — hand-derived field-aware gradients) at matched settings."""
+    F = len(synth.FFM_FIELDS)
+    vocab = synth.ffm_vocab_size()
+    train, test = str(tmp_path / "tr.txt"), str(tmp_path / "te.txt")
+    meta = synth.write_ffm_dataset(train, test, 30000, 8000, seed=5)
+    assert meta["bayes_auc"] > 0.8
+
+    cfg_path = tmp_path / "ckffm.cfg"
+    cfg_path.write_text(f"""
+[General]
+vocabulary_size = {vocab}
+factor_num = 4
+model_type = ffm
+field_num = {F}
+model_file = {tmp_path}/model/ckffm
+log_file = {tmp_path}/log/ckffm.log
+
+[Train]
+train_files = {train}
+epoch_num = {EPOCHS}
+batch_size = 512
+learning_rate = {LR}
+factor_lambda = {LAM}
+bias_lambda = {LAM}
+init_value_range = 0.01
+loss_type = logistic
+max_features_per_example = {F}
+bucket_ladder = {F}
+shuffle = False
+
+[Predict]
+predict_files = {test}
+score_path = {tmp_path}/score
+""")
+    assert run_tffm.main(["train", str(cfg_path)]) == 0
+    assert run_tffm.main(["predict", str(cfg_path)]) == 0
+    scores = np.loadtxt(tmp_path / "score" / "te.txt.score")
+    labels = np.loadtxt(test, usecols=0)
+    fw_auc = exact_auc(scores, labels)
+
+    tr_b = synth.parse_ffm_file(train, 512)
+    te_b = synth.parse_ffm_file(test, 512)
+    oracle_auc = exact_auc(
+        synth.numpy_ffm_train_predict(tr_b, te_b, vocab, k=4, lr=LR,
+                                      epochs=EPOCHS, factor_lambda=LAM,
+                                      bias_lambda=LAM),
+        labels)
+    assert abs(fw_auc - oracle_auc) < 0.015, (fw_auc, oracle_auc)
+    # both learned real signal (0.5 = chance; 30k rows only start to
+    # resolve the pairwise truth, so the bar is modest)
+    assert fw_auc > 0.58, fw_auc
+    assert fw_auc < meta["bayes_auc"]
