@@ -170,15 +170,41 @@ def _pad_batches(blocks, L: int, pad_id: int
         yield ids, x, block.labels.astype(np.float64)
 
 
+def _fm_forward(z: np.ndarray, order: int):
+    """Interaction value per (example, factor dim) and its dz gradient.
+
+    order 2: e2 = (e1² - p2)/2,            d e2/dz_l = e1 - z_l
+    order 3: adds e3 = (e1³ - 3·e1·p2 + 2·p3)/6,
+             d e3/dz_l = e2 − z_l·(e1 − z_l)   (the ANOVA identity:
+             the degree-3 kernel's partial is the degree-2 kernel over
+             the OTHER slots) — matching ops/interaction._anova_terms'
+             "degrees 2..order" definition.
+    Returns (inter [B, k], dz [B, L, k])."""
+    e1 = z.sum(axis=1)                                  # [B, k]
+    p2 = np.square(z).sum(axis=1)
+    e2 = 0.5 * (np.square(e1) - p2)
+    inter = e2.copy()
+    dz = e1[:, None, :] - z                             # [B, L, k]
+    if order == 3:
+        p3 = (z ** 3).sum(axis=1)
+        inter += (e1 ** 3 - 3.0 * e1 * p2 + 2.0 * p3) / 6.0
+        dz = dz + (e2[:, None, :] - z * (e1[:, None, :] - z))
+    elif order != 2:
+        raise ValueError(f"oracle supports order 2 or 3, got {order}")
+    return inter, dz
+
+
 def numpy_fm_train_predict(train_blocks, test_blocks, vocab: int, k: int,
                            lr: float, epochs: int, factor_lambda: float,
                            bias_lambda: float, init_range: float = 0.01,
                            adagrad_init: float = 0.1, seed: int = 7,
-                           L: int = 48) -> np.ndarray:
-    """Train a 2nd-order FM with minibatch Adagrad in pure NumPy and
-    return raw test scores. Padded id slots point at the dead row
-    ``vocab`` with x=0. Backward (per example, g = dloss/dscore):
-        dw[l] = g x_l ;  dv[l, f] = g x_l (s_f - z_{l,f}),  s = Σ_l z.
+                           L: int = 48, order: int = 2) -> np.ndarray:
+    """Train an order-2 (or order-3 ANOVA, BASELINE config #4) FM with
+    minibatch Adagrad in pure NumPy and return raw test scores. Padded
+    id slots point at the dead row ``vocab`` with x=0. Backward (per
+    example, g = dloss/dscore):
+        dw[l] = g x_l ;  dv[l, f] = g x_l · (d inter_f / d z_{l,f})
+    with the interaction/gradient pair in _fm_forward.
     """
     rng = np.random.default_rng(seed)
     W = rng.uniform(-init_range, init_range, size=(vocab + 1, k + 1))
@@ -191,13 +217,11 @@ def numpy_fm_train_predict(train_blocks, test_blocks, vocab: int, k: int,
             rows = W[ids]                                   # [B, L, k+1]
             v, w = rows[..., :k], rows[..., k]
             z = v * x[..., None]                            # [B, L, k]
-            s = z.sum(axis=1)                               # [B, k]
-            score = ((w * x).sum(axis=1)
-                     + 0.5 * (np.square(s) - np.square(z).sum(axis=1))
-                     .sum(axis=1))
+            inter, dz = _fm_forward(z, order)
+            score = (w * x).sum(axis=1) + inter.sum(axis=1)
             p = 1.0 / (1.0 + np.exp(-score))
             g = (p - y) / B                                 # [B]
-            dv = g[:, None, None] * x[..., None] * (s[:, None, :] - z)
+            dv = g[:, None, None] * x[..., None] * dz
             dw = g[:, None] * x
             grad = np.concatenate([dv, dw[..., None]], axis=2)
             # Sparse accumulation onto the batch's unique rows (the
@@ -217,10 +241,8 @@ def numpy_fm_train_predict(train_blocks, test_blocks, vocab: int, k: int,
         rows = W[ids]
         v, w = rows[..., :k], rows[..., k]
         z = v * x[..., None]
-        s = z.sum(axis=1)
-        scores.append((w * x).sum(axis=1)
-                      + 0.5 * (np.square(s)
-                               - np.square(z).sum(axis=1)).sum(axis=1))
+        inter, _ = _fm_forward(z, order)
+        scores.append((w * x).sum(axis=1) + inter.sum(axis=1))
     return np.concatenate(scores)
 
 
@@ -317,11 +339,16 @@ def parse_ffm_file(path: str, batch_size: int):
                 continue
             y_buf.append(float(toks[0]))
             row = np.full(F, -1, np.int64)  # -1 = field unseen: a
-            # truncated/duplicated line must fail loudly below, not
-            # index the weight table with uninitialized memory
+            # truncated or duplicated line must fail loudly here, not
+            # silently train the oracle on different data than the
+            # framework parser sees (which would void parity)
             for t in toks[1:]:
                 f, i = t.split(":")
-                row[int(f)] = int(i)
+                f = int(f)
+                if row[f] >= 0:
+                    raise ValueError(
+                        f"{path}:{lineno}: field {f} appears twice")
+                row[f] = int(i)
             if (row < 0).any():
                 raise ValueError(
                     f"{path}:{lineno}: expected one token per field "

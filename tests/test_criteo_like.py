@@ -87,6 +87,34 @@ score_path = {tmp}/score
     assert fw_auc < meta["bayes_auc"]
 
 
+def test_numpy_oracle_order3_forward_and_grad(rng):
+    """Triangulate the trainer-oracle's order-3 math against the
+    INDEPENDENT per-example ANOVA-DP oracle (models/oracle.fm_score),
+    and its dz gradient against numerical differentiation — so the
+    at-scale order-3 parity run rests on a checked oracle."""
+    from fast_tffm_tpu.data.synth import _fm_forward
+    from fast_tffm_tpu.models.oracle import fm_score
+    B, L, k = 5, 7, 3
+    z = rng.normal(0.0, 0.7, size=(B, L, k))
+    inter, dz = _fm_forward(z, order=3)
+    # forward: ANOVA degrees 2..3 summed over latent dims; fm_score
+    # computes the same from (v, x) — use x=1 so z == v
+    table = np.zeros((L, k + 1))
+    for b in range(B):
+        table[:, :k] = z[b]
+        want = fm_score(table, np.arange(L), np.ones(L), order=3)
+        assert inter[b].sum() == pytest.approx(want, rel=1e-9)
+    # gradient: central differences on the summed interaction
+    eps = 1e-6
+    for (b, l, f) in ((0, 0, 0), (2, 3, 1), (4, 6, 2)):
+        zp, zm = z.copy(), z.copy()
+        zp[b, l, f] += eps
+        zm[b, l, f] -= eps
+        num = (_fm_forward(zp, 3)[0][b].sum()
+               - _fm_forward(zm, 3)[0][b].sum()) / (2 * eps)
+        assert dz[b, l, f] == pytest.approx(num, rel=1e-5)
+
+
 @pytest.mark.slow
 def test_avazu_like_ffm_auc_parity(tmp_path):
     """BASELINE config #3's parity leg: field-aware data from a KNOWN

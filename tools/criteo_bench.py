@@ -62,10 +62,20 @@ score_path = {tmp}/score
 
 
 def main(n_train: int = 1_000_000, n_test: int = 100_000,
-         seed: int = 17, k: int = 8, lr: float = 0.05,
-         model: str = "fm") -> None:
+         seed: int = 17, k: int = None, lr: float = 0.05,
+         model: str = "fm", order: int = 2) -> None:
+    if order not in (2, 3):
+        # fail BEFORE the multi-minute framework leg: the oracle only
+        # implements orders 2 and 3
+        raise SystemExit(f"--order must be 2 or 3, got {order}")
     if model == "ffm":
-        return main_ffm(n_train, n_test, seed=seed, k=k, lr=lr)
+        if order != 2:
+            raise SystemExit("--model ffm supports order 2 only "
+                             "(field-aware FM is pairwise by "
+                             "definition); drop --order")
+        return main_ffm(n_train, n_test, seed=seed,
+                        k=(4 if k is None else k), lr=lr)
+    k = 8 if k is None else k
     import run_tffm
     from fast_tffm_tpu.data import synth
     from fast_tffm_tpu.metrics import exact_auc
@@ -80,10 +90,12 @@ def main(n_train: int = 1_000_000, n_test: int = 100_000,
         gen_sec = time.time() - t0
 
         cfg_path = os.path.join(tmp, "ck.cfg")
+        extra = "hash_feature_id = True"
+        if order != 2:
+            extra += f"\norder = {order}"
         _write_cli_cfg(cfg_path, tmp, train, test, vocab=vocab, k=k,
                        lr=lr, epochs=epochs, lam=lam, batch_size=8192,
-                       mfpe=48, name="ck",
-                       general_extra="hash_feature_id = True")
+                       mfpe=48, name="ck", general_extra=extra)
         t0 = time.time()
         if run_tffm.main(["train", cfg_path]) != 0:
             raise SystemExit("train failed; not recording metrics")
@@ -107,13 +119,14 @@ def main(n_train: int = 1_000_000, n_test: int = 100_000,
         oracle_auc = exact_auc(
             synth.numpy_fm_train_predict(tr, te, vocab, k=k, lr=lr,
                                          epochs=epochs, factor_lambda=lam,
-                                         bias_lambda=lam),
+                                         bias_lambda=lam, order=order),
             labels)
         oracle_sec = time.time() - t0
 
     print(json.dumps({
-        "config": "baseline#1 criteo-kaggle-like",
-        "seed": seed, "k": k, "lr": lr,
+        "config": ("baseline#1 criteo-kaggle-like" if order == 2
+                   else "baseline#4 order-3 criteo-kaggle-like"),
+        "seed": seed, "k": k, "lr": lr, "order": order,
         "n_train": n_train, "n_test": n_test, "epochs": epochs,
         "gen_sec": round(gen_sec, 1),
         "train_sec": round(train_sec, 1),
@@ -204,6 +217,8 @@ if __name__ == "__main__":
                     help="latent dim (default: 8 for fm, 4 for ffm)")
     ap.add_argument("--lr", type=float, default=0.05)
     ap.add_argument("--model", choices=("fm", "ffm"), default="fm")
+    ap.add_argument("--order", type=int, choices=(2, 3), default=2,
+                    help="FM interaction order (fm model only)")
     a = ap.parse_args()
-    k = a.k if a.k is not None else (8 if a.model == "fm" else 4)
-    main(a.n_train, a.n_test, seed=a.seed, k=k, lr=a.lr, model=a.model)
+    main(a.n_train, a.n_test, seed=a.seed, k=a.k, lr=a.lr,
+         model=a.model, order=a.order)
