@@ -6,6 +6,7 @@
 #   make            build the parser extension
 #   make test       run the test suite
 #   make bench      run the benchmark (one JSON line)
+#   make lint       fmlint over the hot-loop modules
 #   make clean
 
 CXX ?= g++
@@ -25,7 +26,10 @@ test: $(SO)
 bench: $(SO)
 	python bench.py
 
+lint:
+	python -m tools.fmlint
+
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench clean
+.PHONY: all test bench lint clean

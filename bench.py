@@ -496,6 +496,22 @@ def _isolated_line(name, train_path):
     return {"trials": None, "device": None, "isolation": "failed"}
 
 
+def _make_bench_telemetry(cfg):
+    """Optional run-telemetry stream (obs/) for the bench: set
+    FM_METRICS_FILE to write the same JSONL schema production train/
+    predict runs emit, with the bench's measured ceilings as
+    ``bench/*`` gauges — so `python -m tools.fmstat` renders the same
+    attribution table for a bench artifact and a real run, directly
+    comparable. Off (None) without the env var: the bench's timed
+    loops then run with zero instrumentation overhead."""
+    path = os.environ.get("FM_METRICS_FILE")
+    if not path:
+        return None
+    from fast_tffm_tpu.obs.telemetry import RunTelemetry, run_meta
+    return RunTelemetry(path, meta=run_meta(cfg, "bench"),
+                        flush_steps=0)
+
+
 def main():
     import tempfile
 
@@ -525,14 +541,35 @@ def main():
         spec = ModelSpec.from_config(cfg)
         step = make_train_step(spec)
 
-        e2e = [run_e2e(cfg, step) for _ in range(TRIALS)]
-        host = run_host_only(cfg)
-        dev = run_device_only(cfg, step)
-        h2d = run_h2d_only(cfg)
-        # Per-worker input rate of the 2-way byte-range sharded fast path
-        # (what each process's pipeline sustains in multi-process mode).
-        shard = run_host_only(cfg, shard_index=0, num_shards=2,
-                              raw_ids=False)
+        tel = _make_bench_telemetry(cfg)
+        from fast_tffm_tpu.obs.telemetry import activate
+        try:
+            with activate(tel):
+                # Headline trials run with the pipeline instrumentation
+                # ACTIVE when FM_METRICS_FILE is set — the measured
+                # number then includes (and bounds) the telemetry
+                # overhead.
+                e2e = [run_e2e(cfg, step) for _ in range(TRIALS)]
+                host = run_host_only(cfg)
+            dev = run_device_only(cfg, step)
+            h2d = run_h2d_only(cfg)
+            # Per-worker input rate of the 2-way byte-range sharded
+            # fast path (what each process's pipeline sustains in
+            # multi-process mode).
+            shard = run_host_only(cfg, shard_index=0, num_shards=2,
+                                  raw_ids=False)
+            if tel is not None:
+                tel.set("bench/e2e", statistics.median(e2e))
+                tel.set("bench/host_only", host)
+                tel.set("bench/device_only", dev)
+                tel.set("bench/h2d_only", h2d)
+                tel.set("bench/sharded_input_per_worker", shard)
+        finally:
+            # The sink buffers EVERYTHING until close; without this a
+            # mid-measurement crash leaves a zero-byte metrics file
+            # (same lifecycle contract train()/predict() keep).
+            if tel is not None:
+                tel.close()
 
         # Deferred in-process fallbacks for failed (not wedged) line
         # subprocesses — AFTER the parent's own measurements, so a

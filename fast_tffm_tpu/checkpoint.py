@@ -47,13 +47,17 @@ class CheckpointState:
         (orbax's own back-pressure), bounding in-flight state to one
         snapshot. ``wait=True`` — the final/preemption save — blocks
         until the bytes are durably committed before returning."""
+        # Plain python ints for the scalar leaves: orbax's
+        # StandardSave supported types are (int, float, np.ndarray,
+        # jax.Array) — numpy SCALARS (np.int64) are rejected outright
+        # by its save-state validation.
         payload = {"table": table, "acc": acc,
-                   "step": np.int64(step),
+                   "step": int(step),
                    # COMPLETED epochs at save time: lets a restarted
                    # run resume an interrupted epoch schedule instead
                    # of rerunning it from zero (train.resume_start_epoch)
-                   "epoch": np.int64(epoch),
-                   "vocab": np.int64(vocabulary_size)}
+                   "epoch": int(epoch),
+                   "vocab": int(vocabulary_size)}
         try:
             self._mngr.save(step, args=ocp.args.StandardSave(payload),
                             force=force)

@@ -120,6 +120,15 @@ class FmConfig:
     profile_dir: str = ""           # empty = profiling off
     profile_start_step: int = 5     # skip compile/warmup steps
     profile_num_steps: int = 10
+    # Run telemetry (obs/; README "Observability"). Off by default.
+    # metrics_file: JSONL event stream path; "auto" means
+    # <model_file>.metrics.jsonl; multi-process runs write
+    # <metrics_file>.p<i> per non-chief worker (merged at read time by
+    # tools/fmstat). metrics_flush_steps: host-event flush cadence in
+    # steps (device scalars still wait for epoch barriers — a flush
+    # adds file I/O only, never a device fetch); 0 = epoch-only.
+    metrics_file: str = ""
+    metrics_flush_steps: int = 100
 
     # --- [Predict] ---------------------------------------------------------
     predict_files: Tuple[str, ...] = ()
@@ -206,6 +215,10 @@ class FmConfig:
             raise ValueError(
                 f"validation_max_batches must be >= 0 (0 = full sweep), "
                 f"got {self.validation_max_batches}")
+        if self.metrics_flush_steps < 0:
+            raise ValueError(
+                f"metrics_flush_steps must be >= 0 (0 = flush at epoch "
+                f"barriers only), got {self.metrics_flush_steps}")
         if ub and self.max_features_per_example >= ub:
             raise ValueError(
                 f"uniq_bucket ({ub}) must exceed max_features_per_example "
@@ -293,6 +306,8 @@ _TRAIN_KEYS = {
     "profile_dir": str,
     "profile_start_step": int,
     "profile_num_steps": int,
+    "metrics_file": str,
+    "metrics_flush_steps": int,
 }
 _PREDICT_KEYS = {
     "predict_files": _split_files,

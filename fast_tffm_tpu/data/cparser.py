@@ -23,6 +23,16 @@ import numpy as np
 
 from fast_tffm_tpu.data.parser import ParsedBlock, ParseError
 
+
+def _tel():
+    """The active run telemetry (obs/), or None. Parser-level counters
+    (lines parsed, parse errors, bytes fed) live HERE — the one layer
+    that sees every line regardless of which pipeline path consumed it.
+    Lazy import: this module must stay importable without obs/ costs
+    when telemetry is off."""
+    from fast_tffm_tpu.obs.telemetry import active
+    return active()
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "_parser.cc")
 _SO = os.path.join(_HERE, "_parser.so")
@@ -229,8 +239,13 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
         max_features_per_example, num_threads,
         ctypes.byref(n_ex), ctypes.byref(nnz),
         labels, poses, ids, vals, fields, errbuf, len(errbuf))
+    tel = _tel()
     if rc != 0:
+        if tel is not None:
+            tel.count("pipeline/parse_errors")
         raise ParseError(errbuf.value.decode("utf-8", "replace"))
+    if tel is not None:
+        tel.count("pipeline/lines_parsed", len(lines))
     b = n_ex.value
     z = nnz.value
     return ParsedBlock(labels=labels[:b].copy(), poses=poses[:b + 1].copy(),
@@ -301,7 +316,16 @@ class BatchBuilder:
                                   ctypes.byref(consumed), self._err,
                                   len(self._err))
         if rc < 0:
+            tel = _tel()
+            if tel is not None:
+                tel.count("pipeline/parse_errors")
             raise ParseError(self._err.value.decode("utf-8", "replace"))
+        tel = _tel()
+        if tel is not None:
+            # The streaming builder never forms Python lines; bytes fed
+            # is its honest parse-volume counter (lines land in
+            # pipeline/examples via the batch wrapper).
+            tel.count("pipeline/bytes_fed", consumed.value)
         return rc == 1, consumed.value
 
     def finish(self):

@@ -65,6 +65,14 @@ class SpillStats:
         self.real_examples += num_real
         self.capacity += batch_size
         self.max_uniq = max(self.max_uniq, num_uniq)
+        if spilled:
+            # Spill visibility also reaches the run's metrics stream
+            # (obs/): this is the single counting point for fixed-U
+            # spills, so the JSONL and the epoch log line can't drift.
+            from fast_tffm_tpu.obs.telemetry import active
+            tel = active()
+            if tel is not None:
+                tel.count("pipeline/spilled_batches")
 
     @property
     def spill_fraction(self) -> float:
@@ -450,6 +458,8 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                     if not line.strip(WHITESPACE) and not keep_empty:
                         continue
                     try:
+                        # fmlint: disable=R001 -- parses a weight-file
+                        # TEXT line; no device value exists here
                         w = float(wline)
                     except ValueError:
                         raise ValueError(
@@ -612,6 +622,53 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    stats: Optional[SpillStats] = None,
                    raw_ids: bool = False
                    ) -> Iterator[DeviceBatch]:
+    """Epoch/shuffle/batch loop over text files (see _batch_iterator_impl
+    for the full contract). This wrapper is the pipeline's telemetry
+    seam: with a run's metrics active (obs/), each built batch feeds
+    the pipeline counters (examples, padding waste, dedup inputs) and
+    a build-seconds histogram — timed HERE, on the producing side, so
+    under prefetch it measures actual build cost on the worker thread,
+    not consumer stall. Inactive (the default), batches pass straight
+    through."""
+    from fast_tffm_tpu.obs.telemetry import active
+    it = _batch_iterator_impl(cfg, files, training=training,
+                              weight_files=weight_files,
+                              shard_index=shard_index,
+                              num_shards=num_shards, epochs=epochs,
+                              batch_size=batch_size, seed=seed,
+                              keep_empty=keep_empty,
+                              fixed_shape=fixed_shape,
+                              uniq_bucket=uniq_bucket, stats=stats,
+                              raw_ids=raw_ids)
+    tel = active()
+    if tel is None:
+        yield from it
+        return
+    import time as _time
+    pad_id = cfg.pad_id
+    while True:
+        t0 = _time.perf_counter()
+        batch = next(it, None)
+        if batch is None:
+            return
+        tel.pipeline_batch(batch, pad_id,
+                           build_seconds=_time.perf_counter() - t0)
+        yield batch
+
+
+def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
+                         training: bool = True,
+                         weight_files: Sequence[str] = (),
+                         shard_index: int = 0, num_shards: int = 1,
+                         epochs: Optional[int] = None,
+                         batch_size: Optional[int] = None,
+                         seed: Optional[int] = None,
+                         keep_empty: bool = False,
+                         fixed_shape: bool = False,
+                         uniq_bucket: int = 0,
+                         stats: Optional[SpillStats] = None,
+                         raw_ids: bool = False
+                         ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files.
 
     Shuffling is a bounded reservoir of ``cfg.queue_size`` lines, the same

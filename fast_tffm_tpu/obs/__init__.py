@@ -1,0 +1,30 @@
+"""obs/ — unified run telemetry (ISSUE 2).
+
+A dependency-free metrics registry (counters, gauges, fixed-bucket
+histograms), a buffered JSONL sink that follows the same link-safety
+discipline as ``utils/summaries.ScalarSummaries`` (device scalars are
+buffered and bulk-fetched only at epoch/flush barriers, never per
+step), and the per-run wiring that lets every stage — data pipeline,
+train loop, predict sweep, lockstep sharded path — feed one merged
+event stream without threading a telemetry handle through every
+signature.
+
+Off by default: everything here is a no-op until a driver activates a
+``RunTelemetry`` (``metrics_file`` config knob). ``active()`` is the
+one lookup instrumented code paths make; when no run is active it
+returns None and the instrumented site costs one global read.
+
+Summarize or tail the resulting file with ``python -m tools.fmstat``.
+"""
+
+from fast_tffm_tpu.obs.registry import (Counter, Gauge, Histogram,
+                                        MetricsRegistry)
+from fast_tffm_tpu.obs.sink import JsonlSink, read_events
+from fast_tffm_tpu.obs.telemetry import (RunTelemetry, activate, active,
+                                         make_telemetry, run_meta)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "JsonlSink", "read_events",
+    "RunTelemetry", "activate", "active", "make_telemetry", "run_meta",
+]

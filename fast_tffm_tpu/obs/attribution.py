@@ -1,0 +1,285 @@
+"""Attribution analysis over a metrics JSONL stream.
+
+The bench learned this lesson first: a single throughput number that
+moves is undiagnosable until it's broken into host-only / device-only /
+transfer-only ceilings (bench.py's ``host_only``/``device_only``/
+``h2d_only``). This module computes the same style of breakdown from a
+run's (or bench's) JSONL event stream, so a production train/predict
+run is diagnosable with the exact vocabulary the bench artifacts use:
+a host-bound vs device/transfer-bound vs pause-bound verdict.
+
+Pure functions over parsed events — shared by ``tools/fmstat`` (CLI)
+and tests; no jax import.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from fast_tffm_tpu.obs.registry import Histogram, MetricsRegistry
+from fast_tffm_tpu.obs.sink import read_events
+
+# Verdict thresholds over the train-loop time split. Above HOST_BOUND
+# of loop wall spent waiting on the input pipeline, the host is the
+# bottleneck (the bench's host_only ceiling binding); above PAUSE_BOUND
+# in checkpoint/summary pauses, cadence knobs are. Otherwise the time
+# is in dispatched device work + H2D, which host-side timing cannot
+# split further — the verdict says so rather than guessing.
+HOST_BOUND_FRACTION = 0.4
+PAUSE_BOUND_FRACTION = 0.3
+
+
+def _run_key(rec: Dict[str, Any]) -> tuple:
+    run = rec.get("run") or {}
+    return (run.get("pid"), run.get("process_index"),
+            run.get("start_time"))
+
+
+def summarize(paths: Sequence[str]) -> Dict[str, Any]:
+    """Merge one or more metrics files (a run + its per-worker shards)
+    into a single summary: final cumulative counters/hists folded
+    across runs, gauges per process, scalars in arrival order."""
+    last_metrics: Dict[tuple, Dict[str, Any]] = {}
+    scalars: List[Dict[str, Any]] = []
+    metas: List[Dict[str, Any]] = []
+    n_events = 0
+    for path in paths:
+        for rec in read_events(path):
+            n_events += 1
+            ev = rec.get("event")
+            if ev == "metrics":
+                # cumulative snapshots: the last one per run carries
+                # everything before it
+                last_metrics[_run_key(rec)] = rec
+            elif ev == "scalar":
+                scalars.append(rec)
+            elif ev == "run_start":
+                metas.append(rec.get("meta") or {})
+
+    merged = MetricsRegistry()
+    gauges_by_proc: Dict[Any, Dict[str, float]] = {}
+    for key, rec in last_metrics.items():
+        for name, v in (rec.get("counters") or {}).items():
+            merged.count(name, v)
+        for name, s in (rec.get("hists") or {}).items():
+            h = merged.histogram(name, bounds=s["bounds"])
+            h.merge(Histogram.from_summary(s))
+        proc = (rec.get("run") or {}).get("process_index", 0)
+        for name, v in (rec.get("gauges") or {}).items():
+            gauges_by_proc.setdefault(proc, {})[name] = v
+    snap = merged.snapshot()
+    # Flat gauge view: single-process reads naturally; multi-process
+    # keeps the chief's values flat and everything per-process too.
+    flat_gauges = dict(gauges_by_proc.get(0, {}))
+    return {
+        "meta": metas[0] if metas else {},
+        "metas": metas,
+        "runs": len(last_metrics),
+        "events": n_events,
+        "counters": snap["counters"],
+        "hists": snap["hists"],
+        "gauges": flat_gauges,
+        "gauges_by_process": gauges_by_proc,
+        "scalars": scalars,
+    }
+
+
+def _frac(num: Optional[float], den: Optional[float]) -> Optional[float]:
+    if not num or not den:
+        return None
+    return num / den
+
+
+def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """The host/device/transfer split + verdict for one summary.
+
+    Two sources, same table: a bench stream carries explicit ceiling
+    gauges (``bench/host_only`` etc. — measured in isolation); a
+    train/predict stream carries the loop-time split (input wait,
+    pauses, step time) and the H2D byte rate.
+    """
+    c = summary.get("counters", {})
+    g = summary.get("gauges", {})
+    h = summary.get("hists", {})
+
+    step = h.get("train/step_seconds") or {}
+    loop_s = step.get("sum") or 0.0
+    steps = c.get("train/steps") or step.get("count") or 0
+    examples = c.get("train/examples", 0)
+    input_wait = c.get("train/input_wait_seconds", 0.0)
+    pauses = (c.get("train/checkpoint_pause_seconds", 0.0)
+              + c.get("train/summary_pause_seconds", 0.0)
+              + c.get("train/validation_seconds", 0.0))
+    h2d_bytes = c.get("train/h2d_bytes", 0.0)
+
+    out: Dict[str, Any] = {
+        "examples": examples,
+        "steps": steps,
+        "loop_seconds": loop_s,
+        "examples_per_sec": _frac(examples, loop_s + pauses),
+        "loop_examples_per_sec": _frac(examples, loop_s),
+        "step_p50_s": step.get("p50"),
+        "step_p99_s": step.get("p99"),
+        "input_wait_fraction": _frac(input_wait, loop_s),
+        "pause_seconds": pauses,
+        "pause_fraction": _frac(pauses, loop_s + pauses),
+        "h2d_bytes_per_sec": _frac(h2d_bytes, loop_s),
+        "dedup_hit_rate": dedup_hit_rate(c),
+        "padding_waste_fraction": padding_waste(c),
+        "parse_errors": c.get("pipeline/parse_errors", 0),
+    }
+
+    # Predict-path stats (a predict stream has no train loop at all;
+    # both can coexist in one file — e.g. train-then-predict appends).
+    p_ex = c.get("predict/examples", 0)
+    p_s = c.get("predict/seconds", 0.0)
+    depth = h.get("predict/fetch_depth") or {}
+    out["predict_examples"] = p_ex
+    out["predict_examples_per_sec"] = _frac(p_ex, p_s)
+    out["predict_fetch_depth_p90"] = depth.get("p90")
+
+    # Bench ceilings, when the stream carries them (bench.py emits
+    # these; a production run can be laid side by side with them).
+    ceilings = {k.split("/", 1)[1]: v for k, v in g.items()
+                if k.startswith("bench/")}
+    if ceilings:
+        out["ceilings"] = ceilings
+        out["verdict"] = _bench_verdict(ceilings)
+        return out
+
+    iw = out["input_wait_fraction"]
+    pf = out["pause_fraction"]
+    if loop_s <= 0 and p_ex:
+        out["verdict"] = _predict_verdict(out)
+        return out
+    if loop_s <= 0:
+        out["verdict"] = "no train-loop data"
+    elif iw is not None and iw > HOST_BOUND_FRACTION:
+        out["verdict"] = (f"host-bound: {iw:.0%} of the loop waits on "
+                          "the input pipeline")
+    elif pf is not None and pf > PAUSE_BOUND_FRACTION:
+        out["verdict"] = (f"pause-bound: {pf:.0%} of run time in "
+                          "checkpoint/summary/validation pauses")
+    else:
+        out["verdict"] = ("device/transfer-bound: the loop keeps the "
+                          "dispatch stream full (host wait "
+                          f"{iw:.0%})" if iw is not None else
+                          "device/transfer-bound")
+    return out
+
+
+def _predict_verdict(att: Dict[str, Any]) -> str:
+    """Verdict for a predict-only stream. The output-order buffer
+    (ChunkedFetcher) backs up exactly when D2H transfer lags scoring —
+    a saturated depth histogram names the transfer as the bottleneck
+    (BASELINE.md "Predict-path rate"); a shallow one means the sweep
+    keeps up and the rate is scoring/host-bound."""
+    rate = att.get("predict_examples_per_sec")
+    base = (f"predict: {rate:,.0f} examples/sec over "
+            f"{att['predict_examples']:,.0f} examples"
+            if rate else "predict stream without rate data")
+    p90 = att.get("predict_fetch_depth_p90")
+    from fast_tffm_tpu.utils.fetch import FETCH_CHUNK_BATCHES
+    if p90 is not None and p90 >= FETCH_CHUNK_BATCHES:
+        return (base + " — transfer-bound: the output-order buffer "
+                f"sits at {p90:.0f} batches (>= the {FETCH_CHUNK_BATCHES}"
+                "-batch fetch chunk), scores wait on D2H")
+    return base + " — host/scoring-bound (output-order buffer shallow)"
+
+
+def _bench_verdict(ceil: Dict[str, float]) -> str:
+    e2e = ceil.get("e2e")
+    named = [(k, v) for k, v in ceil.items()
+             if k in ("host_only", "device_only", "h2d_only") and v]
+    if not e2e or not named:
+        return "bench stream without e2e/ceiling gauges"
+    # The binding constraint is the smallest ceiling; whichever ceiling
+    # sits nearest the e2e number names the bottleneck (bench.py's
+    # reading rule).
+    name, v = min(named, key=lambda kv: abs(kv[1] - e2e))
+    label = {"host_only": "host-bound",
+             "device_only": "device-bound",
+             "h2d_only": "transfer-bound"}[name]
+    return (f"{label}: e2e {e2e:,.0f} ex/s tracks the {name} ceiling "
+            f"({v:,.0f} ex/s)")
+
+
+def dedup_hit_rate(counters: Dict[str, float]) -> Optional[float]:
+    """Fraction of feature occurrences deduplicated away by the host
+    unique pass (1 - uniq_rows/nnz). None in raw-ids mode (the unique
+    set never exists host-side)."""
+    nnz = counters.get("pipeline/feature_nnz")
+    uniq = counters.get("pipeline/uniq_rows")
+    if not nnz or uniq is None:
+        return None
+    return max(0.0, 1.0 - uniq / nnz)
+
+
+def padding_waste(counters: Dict[str, float]) -> Optional[float]:
+    """Fraction of shipped [B, L] feature slots that are padding."""
+    slots = counters.get("pipeline/feature_slots")
+    nnz = counters.get("pipeline/feature_nnz")
+    if not slots:
+        return None
+    return max(0.0, 1.0 - (nnz or 0.0) / slots)
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if 0 < abs(v) < 0.01 or abs(v) >= 1e6:
+            return f"{v:.3g}"
+        return f"{v:,.3f}".rstrip("0").rstrip(".")
+    return str(v)
+
+
+def render(summary: Dict[str, Any]) -> str:
+    """Human-readable attribution table for one merged summary — the
+    fmstat output body."""
+    att = attribution(summary)
+    meta = summary.get("meta", {})
+    lines = []
+    head = [f"kind={meta.get('kind', '?')}",
+            f"backend={meta.get('backend', '?')}",
+            f"devices={meta.get('device_count', '?')}",
+            f"processes={meta.get('process_count', '?')}",
+            f"config={meta.get('config_hash', '?')}",
+            f"git={meta.get('git_rev', '?')}"]
+    lines.append("run: " + " ".join(head))
+    lines.append(f"files merged: {summary.get('runs', 0)} run stream(s), "
+                 f"{summary.get('events', 0)} events")
+    lines.append("")
+    rows = [
+        ("examples", att["examples"]),
+        ("steps", att["steps"]),
+        ("examples/sec (incl pauses)", att["examples_per_sec"]),
+        ("examples/sec (loop only)", att["loop_examples_per_sec"]),
+        ("step p50 / p99 (s)",
+         f"{_fmt(att['step_p50_s'])} / {_fmt(att['step_p99_s'])}"),
+        ("input-wait fraction", att["input_wait_fraction"]),
+        ("pause seconds (ckpt/summary/val)", att["pause_seconds"]),
+        ("h2d bytes/sec", att["h2d_bytes_per_sec"]),
+        ("dedup hit rate", att["dedup_hit_rate"]),
+        ("padding-waste fraction", att["padding_waste_fraction"]),
+        ("parse errors", att["parse_errors"]),
+    ]
+    if att["predict_examples"]:
+        rows += [
+            ("predict examples", att["predict_examples"]),
+            ("predict examples/sec",
+             att["predict_examples_per_sec"]),
+            ("predict fetch-depth p90 (batches)",
+             att["predict_fetch_depth_p90"]),
+        ]
+    for k, v in rows:
+        lines.append(f"  {k:<34} {_fmt(v)}")
+    if "ceilings" in att:
+        lines.append("  bench ceilings (examples/sec):")
+        for k in ("e2e", "host_only", "device_only", "h2d_only"):
+            if k in att["ceilings"]:
+                lines.append(f"    {k:<32} "
+                             f"{_fmt(att['ceilings'][k])}")
+    lines.append("")
+    lines.append(f"verdict: {att['verdict']}")
+    return "\n".join(lines)

@@ -1,0 +1,140 @@
+"""Buffered JSONL event sink with ScalarSummaries' link-safety rules.
+
+Events buffer in host memory and reach disk only at ``flush()`` —
+called from the flush-step cadence and epoch barriers, never per step.
+Device scalars (a jitted step's loss is a device array; materializing
+it mid-stream stalls the async dispatch pipeline for seconds on a
+tunnelled link — BASELINE.md "Device-link sync pathology") are buffered
+AS DEVICE REFERENCES and bulk-fetched in ONE ``utils/fetch.bulk_fetch``
+transfer at ``barrier()`` — the epoch-boundary call — with the same
+1024-entry safety cap as ``train.LOG_BUFFER_MAX``. A plain ``flush()``
+performs zero device fetches, so a mid-epoch flush cadence
+(``metrics_flush_steps``) costs file I/O only.
+
+One line per event, ``json.dumps``-encoded. ``metrics`` events carry
+the run metadata dict every time ("one event per flush with run
+metadata"), so any single line is attributable to its run without
+scanning backwards for a header.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+# Buffered device-scalar cap — the same bound (and rationale) as
+# train.LOG_BUFFER_MAX / summaries.SUMMARY_BUFFER_MAX: a tiny cadence
+# on a months-long epoch must not retain unbounded device scalars; one
+# rare mid-epoch bulk sync is the lesser evil.
+SCALAR_BUFFER_MAX = 1024
+
+
+class JsonlSink:
+    """Append-mode JSONL writer; see module docstring for the buffering
+    and link-safety contract."""
+
+    def __init__(self, path: str, meta: Optional[Dict[str, Any]] = None):
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self.path = path
+        self.meta = dict(meta or {})
+        self._events: List[str] = []
+        self._scalars: List[Tuple[str, int, Any]] = []
+        self._fh = open(path, "a", encoding="utf-8")
+        self._closed = False
+        self.emit("run_start", {"meta": self.meta})
+
+    def emit(self, event: str, fields: Optional[Dict[str, Any]] = None
+             ) -> None:
+        """Queue one host-value event (no device arrays — those go
+        through add_scalar). Buffered until flush()."""
+        rec = {"event": event, "t": time.time()}
+        if fields:
+            rec.update(fields)
+        self._events.append(json.dumps(rec, default=_json_default))
+
+    def emit_metrics(self, step: int, snapshot: Dict[str, Any]) -> None:
+        """One metrics event per flush, run metadata included."""
+        self.emit("metrics", {"step": int(step), "run": self.meta,
+                              **snapshot})
+
+    def add_scalar(self, name: str, step: int, value: Any) -> None:
+        """Queue one scalar whose value may be a DEVICE array; it is
+        not fetched here — barrier() bulk-fetches the whole buffer."""
+        self._scalars.append((name, int(step), value))
+        if len(self._scalars) >= SCALAR_BUFFER_MAX:
+            self._drain_scalars()
+
+    def flush(self) -> None:
+        """Write buffered events to disk. ZERO device fetches: queued
+        device scalars stay queued until the next barrier()."""
+        if self._events:
+            self._fh.write("\n".join(self._events) + "\n")
+            self._events.clear()
+        self._fh.flush()
+
+    def _drain_scalars(self) -> None:
+        if not self._scalars:
+            return
+        # ONE grouped-stacking transfer for the whole buffer (the same
+        # entry point ScalarSummaries.flush and train.flush_log use).
+        from fast_tffm_tpu.utils.fetch import bulk_fetch
+        rows: List[Tuple[str, int, float]] = []
+        bulk_fetch([(v, (name, step))
+                    for name, step, v in self._scalars],
+                   lambda v, m: rows.append(
+                       (m[0], m[1], float(v))))  # host array post-fetch
+        self._scalars.clear()
+        for name, step, val in rows:
+            self.emit("scalar", {"name": name, "step": step, "value": val})
+
+    def barrier(self) -> None:
+        """Epoch/shutdown barrier: bulk-fetch queued device scalars into
+        scalar events, then flush everything to disk."""
+        self._drain_scalars()
+        self.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            # Drain scalars BEFORE queueing run_end so the stream's
+            # last event is always run_end (readers key "run finished
+            # cleanly" off it).
+            self._drain_scalars()
+            self.emit("run_end", {})
+            self.flush()
+        finally:
+            self._fh.close()
+
+
+def _json_default(o: Any):
+    """Numpy scalars/arrays sneak into host-value events (counter sums,
+    batch shapes); coerce rather than crash a telemetry flush."""
+    for attr in ("item",):
+        f = getattr(o, attr, None)
+        if callable(f):
+            try:
+                return f()
+            except Exception:
+                pass
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    return str(o)
+
+
+def read_events(path: str) -> Iterator[Dict[str, Any]]:
+    """Parse a metrics JSONL file (or a worker shard of one). Tolerates
+    a torn final line — a crashed run's file must still summarize."""
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                continue  # torn tail of a crashed run

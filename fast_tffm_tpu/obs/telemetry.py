@@ -1,0 +1,245 @@
+"""Per-run telemetry wiring: registry + sink + the active-run lookup.
+
+Drivers (train/predict/bench) create a ``RunTelemetry`` from the config
+(``make_telemetry``) and run their loops under ``activate(tel)``;
+instrumented library code (data pipeline, lockstep sharded path, C++
+parser wrapper) calls ``active()`` and does nothing when no run is
+active — so the default-off cost at every instrumented site is one
+module-global read, and no signature anywhere grows a telemetry
+parameter.
+
+Multi-process: every process gets its own sink file — process 0 writes
+``metrics_file`` itself, process p > 0 writes ``<metrics_file>.p<p>``
+(same shared-filesystem assumption checkpoints already make) — with
+the process index stamped into the run metadata of every event. The
+streams merge at read time (``tools/fmstat`` accepts several files and
+folds them through the registry's merge rules), not at run time: a
+run-time merge would need a cross-process collective on the hot path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import time
+from typing import Any, Dict, Optional
+
+from fast_tffm_tpu.obs.registry import MetricsRegistry
+from fast_tffm_tpu.obs.sink import JsonlSink
+
+_ACTIVE: Optional["RunTelemetry"] = None
+
+
+def active() -> Optional["RunTelemetry"]:
+    """The run telemetry instrumented library code should feed, or None
+    (the common, zero-cost case)."""
+    return _ACTIVE
+
+
+def push_active(tel: Optional["RunTelemetry"]):
+    """Install ``tel`` as the process-wide active telemetry; returns
+    the previous value for ``pop_active``. The non-contextmanager form
+    exists for drivers whose try/finally spans hundreds of lines —
+    re-indenting the whole train loop under a ``with`` would be worse
+    than a push in setup and a pop in the existing finally."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = tel
+    return prev
+
+
+def pop_active(prev: Optional["RunTelemetry"]) -> None:
+    global _ACTIVE
+    _ACTIVE = prev
+
+
+@contextlib.contextmanager
+def activate(tel: Optional["RunTelemetry"]):
+    """Make ``tel`` the process-wide active telemetry for the body.
+    None passes through (callers don't need their own conditional)."""
+    if tel is None:
+        yield None
+        return
+    prev = push_active(tel)
+    try:
+        yield tel
+    finally:
+        pop_active(prev)
+
+
+def config_hash(cfg) -> str:
+    """Stable short hash of the full config — two JSONL files with the
+    same hash measured the same run shape."""
+    import dataclasses
+    d = dataclasses.asdict(cfg)
+    blob = json.dumps(d, sort_keys=True, default=str).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+def _git_rev() -> Optional[str]:
+    import os
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        return out.stdout.strip() or None if out.returncode == 0 else None
+    except Exception:
+        return None  # telemetry must never block a run on git
+
+
+def run_meta(cfg, kind: str) -> Dict[str, Any]:
+    """Run metadata stamped into every metrics event: config hash,
+    backend, device/process topology, git rev."""
+    import os
+    import jax
+    return {
+        "kind": kind,
+        "config_hash": config_hash(cfg) if cfg is not None else None,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "git_rev": _git_rev(),
+        "pid": os.getpid(),
+        "start_time": time.time(),
+    }
+
+
+class RunTelemetry:
+    """One run's registry + sink + flush cadence.
+
+    ``maybe_flush(step)`` writes a metrics event every ``flush_steps``
+    steps — host values only, zero device fetches. ``barrier_flush``
+    (epoch boundaries, close) additionally bulk-fetches buffered device
+    scalars, the only point device arrays are materialized.
+    """
+
+    def __init__(self, path: str, meta: Dict[str, Any],
+                 flush_steps: int = 0):
+        self.registry = MetricsRegistry()
+        self.sink = JsonlSink(path, meta=meta)
+        self.flush_steps = max(0, int(flush_steps))
+        self._last_flush = time.perf_counter()
+        self._closed = False
+
+    # -- registry passthroughs (the instrumented-site surface) ----------
+    def count(self, name: str, n: float = 1.0) -> None:
+        self.registry.count(name, n)
+
+    def set(self, name: str, v: float) -> None:
+        self.registry.set(name, v)
+
+    def observe(self, name: str, v: float, bounds=None) -> None:
+        self.registry.observe(name, v, bounds)
+
+    def add_scalar(self, name: str, step: int, value) -> None:
+        """Buffer one (possibly device-array) scalar for the next
+        barrier; never fetches here."""
+        self.sink.add_scalar(name, step, value)
+
+    # -- flush cadence --------------------------------------------------
+    def flush_due(self, step: int) -> bool:
+        return bool(self.flush_steps) and step % self.flush_steps == 0
+
+    def maybe_flush(self, step: int) -> None:
+        if self.flush_due(step):
+            self._emit_metrics(step)
+            self.sink.flush()
+
+    def barrier_flush(self, step: int) -> None:
+        self._emit_metrics(step)
+        self.sink.barrier()
+
+    def _emit_metrics(self, step: int) -> None:
+        now = time.perf_counter()
+        self.registry.set("flush/window_seconds", now - self._last_flush)
+        self._last_flush = now
+        self.sink.emit_metrics(step, self.registry.snapshot())
+
+    def close(self, step: int = -1) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if step >= 0:
+            self._emit_metrics(step)
+        else:
+            self.sink.emit_metrics(-1, self.registry.snapshot())
+        self.sink.close()
+
+    # -- shared instrumentation helpers ---------------------------------
+    def pipeline_batch(self, batch, pad_id: int,
+                       build_seconds: Optional[float] = None) -> None:
+        """Per-DeviceBatch pipeline counters: examples/lines, padding
+        waste, dedup hit rate inputs, build time. Runs on the pipeline
+        (prefetch worker) thread; everything here is host numpy."""
+        import numpy as np
+        B, L = batch.local_idx.shape
+        self.count("pipeline/batches")
+        self.count("pipeline/examples", batch.num_real)
+        self.count("pipeline/example_capacity", B)
+        if batch.uniq_ids is None:
+            # raw-ids mode (dedup=device): pad cells hold pad_id
+            # directly; the unique set is computed on device, so no
+            # dedup-rate numerator exists host-side.
+            real = int((batch.local_idx != pad_id).sum())
+        else:
+            real_uniq = int((batch.uniq_ids != pad_id).sum())
+            real = int(
+                (np.asarray(batch.uniq_ids)[batch.local_idx]
+                 != pad_id).sum())
+            self.count("pipeline/uniq_rows", real_uniq)
+        self.count("pipeline/feature_slots", B * L)
+        self.count("pipeline/feature_nnz", real)
+        if build_seconds is not None:
+            self.count("pipeline/build_seconds", build_seconds)
+            self.observe("pipeline/batch_build_seconds", build_seconds)
+
+    def train_step(self, dt: float, n_examples: int,
+                   h2d_bytes: int) -> None:
+        """Per-train-step host-side points: wall time between step
+        dispatches (NOT a device sync — the honest measurable without a
+        fetch), examples, H2D payload bytes."""
+        self.observe("train/step_seconds", dt)
+        self.count("train/steps")
+        self.count("train/examples", n_examples)
+        self.count("train/h2d_bytes", h2d_bytes)
+
+
+def resolve_metrics_path(cfg) -> Optional[str]:
+    """The JSONL path this process should write, or None when metrics
+    are off. ``metrics_file = auto`` follows the sibling-artifact
+    convention (<model_file>.tb/, <model_file>.ckpt/):
+    <model_file>.metrics.jsonl. Non-chief processes get a .p<i> shard
+    suffix so P workers never interleave writes in one file."""
+    path = getattr(cfg, "metrics_file", "") or ""
+    if not path:
+        return None
+    if path == "auto":
+        path = cfg.model_file + ".metrics.jsonl"
+    import jax
+    p = jax.process_index()
+    return path if p == 0 else f"{path}.p{p}"
+
+
+def make_telemetry(cfg, kind: str) -> Optional[RunTelemetry]:
+    """The driver entry point: a RunTelemetry per the config's metrics
+    knobs, or None (the default — metrics_file unset)."""
+    path = resolve_metrics_path(cfg)
+    if path is None:
+        return None
+    return RunTelemetry(path, meta=run_meta(cfg, kind),
+                        flush_steps=cfg.metrics_flush_steps)
+
+
+def batch_payload_bytes(args: Dict[str, Any]) -> int:
+    """Host-side H2D payload estimate for one batch's arg dict (the
+    arrays about to be shipped); no device interaction."""
+    n = 0
+    for v in args.values():
+        nb = getattr(v, "nbytes", None)
+        if nb is not None:
+            n += nb  # a plain int attribute on numpy arrays — no fetch
+    return int(n)

@@ -340,9 +340,14 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     padding its own tail with fillers, so programs stay matched while
     the per-batch host-sync collective and the per-batch blocking score
     fetch both amortize across the window."""
+    import time as _time
     from jax.experimental import multihost_utils
     from fast_tffm_tpu.data.pipeline import empty_batch
     from fast_tffm_tpu.models.fm import batch_args
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()  # per-worker lockstep telemetry (obs/): each
+    # process counts its own rounds/fillers/examples into its own
+    # sink shard; fmstat merges the streams keyed by process index
     n_real = 0
     filler = None
     filler_gargs = None  # device assembly of the all-padding batch is
@@ -350,6 +355,7 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     # (H2D is the documented bottleneck on a tunnelled chip)
     while True:
         window = []
+        t_fill = _time.perf_counter()
         while len(window) < LOCKSTEP_WINDOW:
             if max_batches and n_real + len(window) >= max_batches:
                 break
@@ -360,6 +366,18 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         fills = multihost_utils.process_allgather(
             np.asarray([len(window)]))
         rounds = int(fills.max())
+        if tel is not None and rounds:
+            tel.count("lockstep/windows")
+            # Collective programs this round == the window max across
+            # workers; real + filler always sums to it, so the three
+            # counters cross-check.
+            tel.count("lockstep/programs", rounds)
+            tel.count("lockstep/real_batches", len(window))
+            # Filler programs this worker runs because a PEER's shard
+            # is longer — the load-imbalance signal per worker.
+            tel.count("lockstep/filler_batches", rounds - len(window))
+            tel.count("lockstep/window_fill_seconds",
+                      _time.perf_counter() - t_fill)
         if rounds == 0:
             return
         pending = []
@@ -381,6 +399,9 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
             if i < len(window):
                 pending.append((batch, score))
         n_real += len(window)
+        if tel is not None:
+            tel.count("lockstep/examples",
+                      sum(b.num_real for b in window))
         for batch, score in pending:
             # This process's rows of the global [B_global] score vector
             # are exactly its local batch (global_batch concatenates

@@ -10,6 +10,7 @@ produces ``<score_path>/<basename(f)>.score``.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional
 
 import jax
@@ -22,8 +23,14 @@ from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
 from fast_tffm_tpu.metrics import sigmoid
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
                                      make_batch_scorer, ships_raw_batches)
+from fast_tffm_tpu.obs.telemetry import (active, make_telemetry,
+                                         pop_active, push_active)
 from fast_tffm_tpu.utils.fetch import ChunkedFetcher
 from fast_tffm_tpu.utils.logging import get_logger
+
+# Output-order buffer depth buckets (batches retained between bulk
+# fetches): powers of two up to 4x FETCH_CHUNK_BATCHES.
+_DEPTH_BUCKETS = tuple(2 ** i for i in range(11))
 
 
 def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
@@ -72,6 +79,7 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     # of predict_e2e on this link; BASELINE.md "Predict-path rate").
     fetcher = ChunkedFetcher(lambda s, num_real: out.append(s[:num_real]),
                              overlap=True)
+    tel = active()
     for batch in prefetch(batch_iterator(cfg, files, training=False,
                                          epochs=1, keep_empty=True,
                                          raw_ids=raw),
@@ -81,6 +89,14 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
         args = batch_args(batch)
         args.pop("labels"), args.pop("weights")
         fetcher.add(score_fn(table, args), batch.num_real)
+        if tel is not None:
+            tel.count("predict/batches")
+            tel.count("predict/examples", batch.num_real)
+            # Output-order buffer: device score arrays held back so
+            # results land in input order — its depth is the D2H
+            # backlog (BASELINE.md "Predict-path rate").
+            tel.observe("predict/fetch_depth", fetcher.pending_depth,
+                        bounds=_DEPTH_BUCKETS)
     fetcher.flush()
     return (np.concatenate(out) if out
             else np.zeros(0, dtype=np.float32))
@@ -103,6 +119,26 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
     if job_name is not None:
         from fast_tffm_tpu.parallel.distributed import init_from_cluster
         init_from_cluster(cfg, job_name, task_index or 0)
+    # Run telemetry (obs/): created after cluster init so the process
+    # index in the run metadata (and the per-worker shard suffix) is
+    # real. The try/finally below is the sink's lifecycle guarantee —
+    # a crash mid-sweep still flushes everything buffered.
+    tel = make_telemetry(cfg, "predict")
+    tel_prev = push_active(tel)
+    try:
+        written = _predict_body(cfg, table, logger)
+        return written
+    finally:
+        if tel is not None:
+            try:
+                tel.close()
+            except Exception:
+                logger.exception("metrics sink close failed")
+        pop_active(tel_prev)
+
+
+def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
+    tel = active()
     if jax.process_count() > 1:
         if cfg.lookup == "host":
             raise ValueError("lookup = host predict is single-process")
@@ -146,8 +182,10 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
     os.makedirs(cfg.score_path, exist_ok=True)
     written = []
     for path in expand_files(cfg.predict_files):
+        t0 = time.perf_counter()
         raw = predict_scores(cfg, table, [path], mesh=mesh,
                              backend=backend)
+        dt = time.perf_counter() - t0
         vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
         out_path = os.path.join(cfg.score_path,
                                 os.path.basename(path) + ".score")
@@ -156,6 +194,16 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
                 fh.write(f"{v:.6f}\n")
         logger.info("wrote %d scores to %s", len(vals), out_path)
         written.append(out_path)
+        if tel is not None:
+            rate = len(raw) / dt if dt > 0 else 0.0
+            tel.count("predict/seconds", dt)
+            tel.set("predict/examples_per_sec", rate)
+            tel.sink.emit("predict_file",
+                          {"path": path, "examples": len(raw),
+                           "seconds": dt, "examples_per_sec": rate})
+            # Per-file barrier: scores are already host-side here, so
+            # the flush is pure file I/O.
+            tel.barrier_flush(step=len(written))
     return written
 
 
@@ -187,8 +235,10 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
     score_fn = make_sharded_score_fn(spec, mesh)
     p, P = jax.process_index(), jax.process_count()
     os.makedirs(cfg.score_path, exist_ok=True)
+    tel = active()
     written: List[str] = []
     for path in expand_files(cfg.predict_files):
+        t0 = time.perf_counter()
         # Deterministic probe: every process reads the same bytes, so
         # all agree on U without a collective.
         ub = cfg.uniq_bucket or probe_uniq_bucket(cfg, [path])
@@ -230,4 +280,18 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
         multihost_utils.sync_global_devices(f"predict_merged_{tag}")
         os.remove(part)
         written.append(out_path)
+        if tel is not None:
+            # Per-WORKER rate for this worker's shard; the merged view
+            # (fmstat over all .p<i> shards) sums examples and seconds
+            # across processes, keyed by process index in the metadata.
+            dt = time.perf_counter() - t0
+            n_local = len(raw)
+            tel.count("predict/seconds", dt)
+            tel.count("predict/examples", n_local)
+            tel.set("predict/examples_per_sec",
+                    n_local / dt if dt > 0 else 0.0)
+            tel.sink.emit("predict_file",
+                          {"path": path, "examples": n_local,
+                           "seconds": dt, "process_index": p})
+            tel.barrier_flush(step=len(written))
     return written

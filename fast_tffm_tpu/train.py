@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import signal
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -30,6 +31,9 @@ from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
                                      make_train_step, ships_raw_batches)
+from fast_tffm_tpu.obs.telemetry import (batch_payload_bytes,
+                                         make_telemetry, pop_active,
+                                         push_active)
 from fast_tffm_tpu.utils.fetch import ChunkedFetcher, bulk_fetch
 from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.timing import StepTimer, trace_span
@@ -333,6 +337,17 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     stopping = False
     last_val = None  # (auc, n) of the most recent validation pass
 
+    # Run telemetry (obs/; metrics_file knob): counters/gauges/
+    # histograms flushed as JSONL. Every process writes its own shard
+    # file; device scalars (loss) buffer and bulk-fetch only at epoch
+    # barriers — same link-safety discipline as summaries/log_buffer.
+    tel = make_telemetry(cfg, "train")
+    if tel is not None:
+        logger.info(
+            "writing run metrics to %s (flush every %s steps; summarize "
+            "with: python -m tools.fmstat %s)", tel.sink.path,
+            tel.flush_steps or "epoch", tel.sink.path)
+
     # TensorBoard scalars (save_summaries_steps; utils/summaries.py).
     # Chief-only, and flushed ONLY at epoch barriers: values buffer as
     # device scalars so the cadence adds zero mid-stream fetches.
@@ -370,6 +385,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         cost = float("inf")
         for _ in range(3):  # min of 3: jitter must not misclassify
             t0 = _time.perf_counter()
+            # fmlint: disable=R001 -- this IS the link probe: one
+            # deliberate timed scalar fetch, before the hot loop starts
             float(probe)
             cost = min(cost, _time.perf_counter() - t0)
         if cost < LIVE_FETCH_BUDGET_S:
@@ -422,6 +439,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     # finally also covers exceptions, so a failed in-process train()
     # can't leave the surviving process (pytest, REPL, server) with
     # SIGTERM/SIGINT swallowed into a dead flag list.
+    tel_prev = push_active(tel)  # popped in the finally, crash or not
     try:
         completed_epochs = start_epoch
         last_periodic_save = (None, None)  # (step, epoch) of the latest
@@ -437,8 +455,20 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 stats=epoch_stats, raw_ids=raw_mode),
                 depth=cfg.prefetch_depth,
                 gil_bound=gil_bound_iteration(cfg, cfg.weight_files))
+            t_step_prev = time.perf_counter()
             while True:
+                # Consumer-side stall: time blocked INSIDE next() only —
+                # bracketing it any wider would fold end-of-step
+                # bookkeeping (notably live-mode's deliberate
+                # float(loss) device sync in log_tick) into the
+                # host-bound signal and misdiagnose a device-bound run
+                # (the producer-side build cost is timed separately in
+                # pipeline.batch_iterator on the worker thread).
+                t_in = time.perf_counter() if tel is not None else 0.0
                 batch = next(it, None)
+                if tel is not None:
+                    tel.count("train/input_wait_seconds",
+                              time.perf_counter() - t_in)
                 if multi_process:
                     # Lockstep: line-index sharding can give processes
                     # batch counts differing by one; every step is a
@@ -469,6 +499,10 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     if batch is None:
                         break
                 args = batch_args(batch)
+                # H2D payload sized host-side, BEFORE placement turns
+                # the numpy arrays into device arrays.
+                h2d_bytes = (batch_payload_bytes(args)
+                             if tel is not None else 0)
                 if multi_process:
                     args = global_batch(mesh, len(batch.uniq_ids), **args)
                 elif mesh is not None:
@@ -482,24 +516,45 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     table, acc, loss, _ = step_fn(table, acc, **args)
                 global_step += 1
                 last_val = None  # table advanced; any cached AUC is stale
-                timer.tick(batch.num_real * (jax.process_count()
-                                             if multi_process else 1))
+                n_global = batch.num_real * (jax.process_count()
+                                             if multi_process else 1)
+                timer.tick(n_global)
+                if tel is not None:
+                    # Wall time since the previous step's bookkeeping —
+                    # dispatch-loop time, never a device sync. Reset per
+                    # epoch so validation/pause gaps stay out of the
+                    # histogram (they have their own counters).
+                    now = time.perf_counter()
+                    tel.train_step(now - t_step_prev, n_global,
+                                   h2d_bytes)
+                    t_step_prev = now
                 profile_tick(global_step)
                 log_due = (cfg.log_steps
                            and global_step % cfg.log_steps == 0)
                 sum_due = (summaries is not None and global_step
                            % cfg.save_summaries_steps == 0)
+                tel_due = tel is not None and tel.flush_due(global_step)
                 # One windowed-rate read per step: the read consumes
-                # the window, so the log line and the summary share it.
+                # the window, so the log line, the summary, and the
+                # metrics gauge all share it.
                 eps_now = (timer.consume_window_rate()
-                           if (log_due or sum_due) else None)
+                           if (log_due or sum_due or tel_due) else None)
                 if log_due:
                     log_tick(global_step, epoch, loss, eps_now)
                 if sum_due:
                     summaries.add("train/loss", global_step, loss)
                     summaries.add("train/examples_per_sec", global_step,
                                   eps_now)
+                if tel_due:
+                    # loss is a DEVICE scalar: buffered, fetched only at
+                    # the next epoch barrier (sink link-safety contract).
+                    tel.add_scalar("train/loss", global_step, loss)
+                    tel.set("train/examples_per_sec_window", eps_now)
+                    tel.set("train/examples_per_sec_total",
+                            timer.total_examples_per_sec)
+                    tel.maybe_flush(global_step)  # file I/O only
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
+                    t_ck = time.perf_counter()
                     state = (lk.state() if offload
                              else ckpt_state(cfg, table, acc))
                     # Device arrays: async save (orbax D2H-snapshots
@@ -511,6 +566,13 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                               vocabulary_size=cfg.vocabulary_size,
                               wait=offload, epoch=completed_epochs)
                     last_periodic_save = (global_step, completed_epochs)
+                    if tel is not None:
+                        dt_ck = time.perf_counter() - t_ck
+                        tel.count("train/checkpoint_pause_seconds",
+                                  dt_ck)
+                        tel.count("train/checkpoints")
+                        t_step_prev += dt_ck  # keep the pause out of
+                        # the next step's step_seconds sample
             flush_log()  # deferred loss lines land at the epoch barrier
             if epoch_stats.spilled_batches or (multi_process
                                                and epoch_stats.batches):
@@ -537,11 +599,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     [epoch_stats.spilled_batches, epoch_stats.batches,
                      epoch_stats.max_uniq]))
                 tot = tot.reshape(-1, 3)
+                # fmlint: disable=R001 -- tot is the HOST numpy result
+                # of process_allgather; these ints never touch a device
                 uniq_bucket = adapt_uniq_bucket(
                     cfg, uniq_bucket, int(tot[:, 0].sum()),
                     int(tot[:, 1].sum()), logger,
                     max_uniq=int(tot[:, 2].max()))
             if cfg.validation_files and not stopping:
+                t_val = time.perf_counter()
                 vmb = cfg.validation_max_batches or None
                 if multi_process:
                     auc, n = evaluate_distributed(
@@ -562,8 +627,25 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         epoch, auc, n)
                 if summaries is not None:
                     summaries.add("validation/auc", global_step, auc)
+                if tel is not None:
+                    tel.count("train/validation_seconds",
+                              time.perf_counter() - t_val)
+                    tel.set("validation/auc", auc)
+                    # fmlint: disable=R001 -- auc is already a host
+                    # python float from the streamed AUC merge
+                    tel.add_scalar("validation/auc", global_step,
+                                   float(auc))
             if summaries is not None:  # epoch barrier: bulk-fetch + write
+                t_sum = time.perf_counter()
                 summaries.flush()
+                if tel is not None:
+                    tel.count("train/summary_pause_seconds",
+                              time.perf_counter() - t_sum)
+            if tel is not None:
+                # Epoch barrier: the one point buffered device scalars
+                # are bulk-fetched and the JSONL reaches disk for sure.
+                tel.count("train/epochs")
+                tel.barrier_flush(global_step)
             if not stopping:  # a preemption-cut epoch is NOT completed
                 completed_epochs = epoch + 1
         flush_log()
@@ -612,6 +694,15 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                            vocabulary_size=cfg.vocabulary_size)
     finally:
         try:
+            # Sink lifecycle on error paths: a crash mid-epoch must not
+            # drop everything buffered since the last flush — the log
+            # buffer, the TensorBoard scalars, and the metrics sink all
+            # drain here, each isolated so one broken writer can't
+            # starve the others.
+            try:
+                flush_log()
+            except Exception:
+                logger.exception("deferred loss-log flush failed")
             if summaries is not None:
                 # Buffered scalars must reach the event file even when
                 # the loop raised or a preemption cut the final epoch.
@@ -619,6 +710,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     summaries.close()
                 except Exception:
                     logger.exception("summary writer close failed")
+            if tel is not None:
+                try:
+                    tel.close(global_step)
+                except Exception:
+                    logger.exception("metrics sink close failed")
+            pop_active(tel_prev)
             if profiling:
                 # Window ran past the end of training — or the loop
                 # raised with the window open; either way the trace must

@@ -68,6 +68,16 @@ class ChunkedFetcher:
         self._worker = None
         self._err: List[BaseException] = []
 
+    @property
+    def pending_depth(self) -> int:
+        """Entries currently held back for in-order delivery: the
+        in-build pending list plus any full chunk queued behind the
+        worker. A cheap host-side read for telemetry (the predict
+        path's output-order buffer-depth gauge) — approximate by
+        design: the worker may be mid-fetch on one more chunk."""
+        q = self._queue
+        return len(self._pending) + (q.qsize() * self._chunk if q else 0)
+
     def add(self, arr, meta: Any = None) -> None:
         if self._err:
             # Deliver the worker's error through the same drain + join +

@@ -8,6 +8,7 @@ annotation and throughput is measured with ``block_until_ready`` fences.
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 
 import jax
@@ -71,8 +72,20 @@ def trace_span(name: str):
 
 @contextlib.contextmanager
 def profile_to(log_dir: str):
-    jax.profiler.start_trace(log_dir)
+    """Trace the body into ``log_dir`` (created if missing — jax's own
+    error for a missing dir is an opaque profiler failure mid-run).
+
+    stop_trace runs EXACTLY once, and only if start_trace succeeded: a
+    start_trace that raises (unwritable dir, trace already running)
+    must not trigger a stop here — that would either mask the original
+    error with "no trace in progress" or, worse, stop an OUTER trace
+    the caller still owns."""
+    os.makedirs(log_dir, exist_ok=True)
+    started = False
     try:
+        jax.profiler.start_trace(log_dir)
+        started = True
         yield
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            jax.profiler.stop_trace()

@@ -65,6 +65,14 @@ def main(argv=None) -> int:
     rest = argv[2:]
     _enable_compilation_cache()
     cfg = load_config(cfg_path)
+    # One-off telemetry without editing the config file: the same
+    # values the `metrics_file` knob takes ("auto" =
+    # <model_file>.metrics.jsonl). Summarize with
+    # `python -m tools.fmstat <file>`.
+    metrics_override = os.environ.get("FM_METRICS_FILE")
+    if metrics_override:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, metrics_file=metrics_override)
 
     job_name = task_index = None
     if rest:

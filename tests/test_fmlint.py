@@ -1,0 +1,151 @@
+"""tools/fmlint: the hot-loop device-fetch/print rules, suppression
+grammar, and the repo-wide lint gate (this file IS the tier-1 wiring —
+a hot-loop regression fails the suite here)."""
+
+import os
+import textwrap
+
+import pytest
+
+from tools.fmlint.core import run_file, run_paths
+from tools.fmlint.rules import is_hot_module
+
+
+def _hot_file(tmp_path, body):
+    """Write ``body`` at a path the rules treat as a hot module."""
+    d = tmp_path / "fast_tffm_tpu"
+    d.mkdir(exist_ok=True)
+    p = d / "train.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_repo_hot_modules_are_clean():
+    """The lint gate: the shipped hot-loop modules must have zero
+    findings (deliberate exceptions carry justified pragmas)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = run_paths([os.path.join(root, "fast_tffm_tpu")])
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_is_hot_module_scope():
+    assert is_hot_module("x/fast_tffm_tpu/train.py")
+    assert is_hot_module("x/fast_tffm_tpu/predict.py")
+    assert is_hot_module("x/fast_tffm_tpu/data/pipeline.py")
+    assert is_hot_module("x/fast_tffm_tpu/obs/sink.py")
+    assert not is_hot_module("x/fast_tffm_tpu/metrics.py")
+    assert not is_hot_module("x/bench.py")
+    assert not is_hot_module("x/tools/fmstat/__init__.py")
+
+
+def test_r001_flags_scalar_fetch_in_loop(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(it, step):
+            for batch in it:
+                loss = step(batch)
+                print_loss = float(loss)
+            return loss
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R001"]
+    assert found[0].line == 4
+
+
+def test_r001_flags_item_anywhere(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def read(loss):
+            return loss.item()
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R001"]
+
+
+def test_r001_allows_fetch_outside_loops(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def final(loss):
+            return float(loss)
+    """)
+    assert run_file(path) == []
+
+
+def test_r002_flags_bare_print(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def log(x):
+            print(x)
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R002"]
+
+
+def test_rules_scope_to_hot_modules_only(tmp_path):
+    p = tmp_path / "other.py"
+    p.write_text("def f(it):\n    for x in it:\n        print(float(x))\n")
+    assert run_file(str(p)) == []
+
+
+def test_inline_pragma_suppresses_with_justification(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(it):
+            for x in it:
+                v = float(x)  # fmlint: disable=R001 -- host value
+            return v
+    """)
+    assert run_file(path) == []
+
+
+def test_wholeline_pragma_covers_next_statement(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(it, f):
+            for x in it:
+                # fmlint: disable=R001 -- host allgather results
+                v = f(int(x[0]),
+                      int(x[1]),
+                      int(x[2]))
+            return v
+    """)
+    assert run_file(path) == []
+
+
+def test_pragma_without_justification_is_r000(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(it):
+            for x in it:
+                v = float(x)  # fmlint: disable=R001
+            return v
+    """)
+    rules = sorted(f.rule for f in run_file(path))
+    # the naked pragma is reported AND does not suppress
+    assert rules == ["R000", "R001"]
+
+
+def test_disable_file_pragma(tmp_path):
+    path = _hot_file(tmp_path, """\
+        # fmlint: disable-file=R002 -- exercise harness, prints wanted
+        def a(x):
+            print(x)
+        def b(it):
+            for v in it:
+                print(v)
+    """)
+    assert run_file(path) == []
+
+
+def test_syntax_error_reports_r999(tmp_path):
+    path = _hot_file(tmp_path, "def broken(:\n")
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R999"]
+
+
+def test_cli_main(tmp_path, capsys):
+    from tools.fmlint.core import main
+    bad = _hot_file(tmp_path, """\
+        def run(it):
+            for x in it:
+                print(float(x))
+    """)
+    assert main([bad]) == 1
+    out = capsys.readouterr()
+    assert "R001" in out.out and "R002" in out.out
+    ok = tmp_path / "clean.py"
+    ok.write_text("x = 1\n")
+    assert main([str(ok)]) == 0

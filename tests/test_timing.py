@@ -1,0 +1,124 @@
+"""utils/timing.py: StepTimer window semantics and profile_to's
+start/stop lifecycle (ISSUE 2 satellites)."""
+
+import os
+
+import pytest
+
+import fast_tffm_tpu.utils.timing as timing
+from fast_tffm_tpu.utils.timing import StepTimer, profile_to
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    c = FakeClock()
+    monkeypatch.setattr(timing.time, "perf_counter", c)
+    return c
+
+
+def test_consume_resets_window(clock):
+    t = StepTimer()
+    clock.advance(2.0)
+    t.tick(100)
+    assert t.consume_window_rate() == pytest.approx(50.0)
+    # window consumed: the next read covers only what came after
+    clock.advance(1.0)
+    t.tick(10)
+    assert t.consume_window_rate() == pytest.approx(10.0)
+    # and an immediate re-read sees an empty window, not a repeat
+    clock.advance(1.0)
+    assert t.consume_window_rate() == 0.0
+
+
+def test_zero_dt_guard(clock):
+    t = StepTimer()
+    t.tick(100)  # no clock advance: dt == 0 exactly
+    assert t.consume_window_rate() == 0.0
+    assert t.total_examples_per_sec == 0.0
+
+
+def test_total_rate_includes_pauses(clock):
+    t = StepTimer()
+    clock.advance(1.0)
+    t.tick(100)
+    t.consume_window_rate()
+    clock.advance(9.0)  # a long validation/checkpoint pause
+    t.tick(100)
+    # window rate excludes everything before its reset...
+    assert t.consume_window_rate() == pytest.approx(100 / 9.0)
+    # ...total anchors at construction, absorbing the pause
+    assert t.total_examples_per_sec == pytest.approx(200 / 10.0)
+    assert t.steps == 2
+
+
+def test_reset_clears_everything(clock):
+    t = StepTimer()
+    clock.advance(1.0)
+    t.tick(50)
+    t.reset()
+    clock.advance(2.0)
+    t.tick(10)
+    assert t.steps == 1
+    assert t.total_examples_per_sec == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------- profile_to
+
+class FakeProfiler:
+    def __init__(self, fail_start=False):
+        self.starts = []
+        self.stops = 0
+        self.fail_start = fail_start
+
+    def start_trace(self, log_dir):
+        if self.fail_start:
+            raise RuntimeError("trace already in progress")
+        self.starts.append(log_dir)
+
+    def stop_trace(self):
+        self.stops += 1
+
+
+@pytest.fixture
+def profiler(monkeypatch):
+    p = FakeProfiler()
+    monkeypatch.setattr(timing.jax, "profiler", p)
+    return p
+
+
+def test_profile_to_creates_log_dir_and_stops_once(tmp_path, profiler):
+    d = str(tmp_path / "a" / "b")  # parent missing too
+    with profile_to(d):
+        pass
+    assert os.path.isdir(d)
+    assert profiler.starts == [d] and profiler.stops == 1
+
+
+def test_profile_to_stops_once_when_body_raises(tmp_path, profiler):
+    d = str(tmp_path / "t")
+    with pytest.raises(ValueError, match="body failed"):
+        with profile_to(d):
+            raise ValueError("body failed")
+    assert profiler.stops == 1
+
+
+def test_profile_to_no_stop_when_start_fails(tmp_path, monkeypatch):
+    """start_trace raising must NOT trigger a stop: that would mask
+    the original error or stop an outer trace the caller owns."""
+    p = FakeProfiler(fail_start=True)
+    monkeypatch.setattr(timing.jax, "profiler", p)
+    with pytest.raises(RuntimeError, match="trace already in progress"):
+        with profile_to(str(tmp_path / "t")):
+            pass  # pragma: no cover - never reached
+    assert p.stops == 0

@@ -1,0 +1,7 @@
+"""Operational tooling: benches, probes, and the fmstat/fmlint CLIs.
+
+A package (not loose scripts) so `python -m tools.fmstat` /
+`python -m tools.fmlint` work from the repo root — the standalone
+scripts (criteo_bench.py, kernel_probe.py, offload_smoke.py) still run
+directly as before.
+"""

@@ -1,0 +1,32 @@
+"""fmlint — AST-based static checks for this repo's performance
+invariants.
+
+The invariants live in prose (README "Device-link sync pathology",
+BASELINE.md's measured one-fetch-collapses-dispatch pathology); this
+package makes the hot-loop subset machine-checked and wires it into
+the tier-1 test run (tests/test_fmlint.py):
+
+R001  per-scalar device fetch in a hot-loop module: ``float(x)`` /
+      ``int(x)`` inside a loop body, or any ``.item()`` call — one
+      synchronous scalar materialization in the hot stream costs
+      seconds over a tunnelled device link (measured 528k -> 50k
+      examples/sec).
+R002  bare ``print(`` in a hot-loop module: stdout writes block the
+      dispatch loop and bypass the logging/telemetry sinks.
+
+Hot-loop modules: train.py, predict.py, data/pipeline.py, and all of
+obs/ (the telemetry layer must never cause the stalls it measures).
+
+Deliberate exceptions carry a justified pragma:
+
+    x = float(probe)  # fmlint: disable=R001 -- pre-loop link probe
+
+A whole-line pragma comment suppresses the entire next statement; a
+pragma without a ``--`` justification is itself reported (R000).
+
+Run: ``python -m tools.fmlint`` (repo default paths) or pass files.
+"""
+
+from tools.fmlint.core import Finding, main, run_paths
+
+__all__ = ["Finding", "main", "run_paths"]

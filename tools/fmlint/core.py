@@ -1,0 +1,169 @@
+"""fmlint core: findings, suppression pragmas, runner, CLI.
+
+Rules are stdlib-``ast`` analyses (tools/fmlint/rules.py) run per
+file; findings then filter through the suppression pragmas:
+
+    x = float(loss)   # fmlint: disable=R001 -- probed link, live mode
+    # fmlint: disable=R001 -- host allgather result, not a device array
+    spilled = int(tot[:, 0].sum())
+    # fmlint: disable-file=R002 -- CLI module, print IS the output
+
+``disable=`` on a code line suppresses matching findings on that line;
+as a whole-line comment it suppresses the entire NEXT statement
+(multi-line calls included). ``disable-file=`` suppresses the rule for
+the whole file. The text after ``--`` is the REQUIRED justification —
+a pragma without one is itself a finding (R000).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+_PRAGMA = re.compile(
+    r"#\s*fmlint:\s*(disable|disable-file)=([A-Z0-9,]+)"
+    r"(?:\s*--\s*(.*))?")
+
+
+@dataclasses.dataclass
+class Suppressions:
+    # rule -> set of suppressed line numbers (resolved statement spans)
+    lines: Dict[str, Set[int]]
+    file_rules: Set[str]
+    bad_pragmas: List[Finding]  # R000: pragma without justification
+
+    def allows(self, f: Finding) -> bool:
+        if f.rule in self.file_rules:
+            return True
+        return f.line in self.lines.get(f.rule, ())
+
+
+def _statement_spans(tree: ast.AST) -> List[Tuple[int, int]]:
+    """(lineno, end_lineno) for every statement, sorted by start."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.stmt):
+            spans.append((node.lineno, node.end_lineno or node.lineno))
+    return sorted(spans)
+
+
+def parse_suppressions(path: str, source: str,
+                       tree: ast.AST) -> Suppressions:
+    lines: Dict[str, Set[int]] = {}
+    file_rules: Set[str] = set()
+    bad: List[Finding] = []
+    spans = _statement_spans(tree)
+
+    def next_stmt_span(after_line: int) -> Tuple[int, int]:
+        for lo, hi in spans:
+            if lo > after_line:
+                return lo, hi
+        return after_line + 1, after_line + 1
+
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA.search(text)
+        if not m:
+            continue
+        kind, rules_s, why = m.groups()
+        rules = [r for r in rules_s.split(",") if r]
+        if not (why or "").strip():
+            bad.append(Finding(
+                "R000", path, i,
+                "suppression pragma without a `-- justification`"))
+            continue
+        if kind == "disable-file":
+            file_rules.update(rules)
+            continue
+        whole_line = text.lstrip().startswith("#")
+        if whole_line:
+            lo, hi = next_stmt_span(i)
+            covered = range(lo, hi + 1)
+        else:
+            covered = (i,)
+        for r in rules:
+            lines.setdefault(r, set()).update(covered)
+    return Suppressions(lines=lines, file_rules=file_rules,
+                       bad_pragmas=bad)
+
+
+def run_file(path: str) -> List[Finding]:
+    from tools.fmlint.rules import RULES
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("R999", path, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    supp = parse_suppressions(path, source, tree)
+    found: List[Finding] = list(supp.bad_pragmas)
+    for rule_fn in RULES:
+        found.extend(f for f in rule_fn(path, tree)
+                     if not supp.allows(f))
+    return sorted(found, key=lambda f: (f.path, f.line, f.rule))
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    """Expand dirs to their .py files. A path that doesn't exist or
+    isn't lintable raises — a typo'd lint target must fail the gate,
+    not exit 0 having linted zero files."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                if "__pycache__" in root:
+                    continue
+                out.extend(os.path.join(root, n) for n in sorted(names)
+                           if n.endswith(".py"))
+        elif os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        else:
+            raise FileNotFoundError(
+                f"fmlint: {p!r} is not a directory or .py file")
+    return out
+
+
+def run_paths(paths: Sequence[str]) -> List[Finding]:
+    found: List[Finding] = []
+    for f in collect_files(paths):
+        found.extend(run_file(f))
+    return found
+
+
+def default_paths() -> List[str]:
+    """The repo's lint surface when run with no arguments: the whole
+    package (each rule scopes itself to the modules it governs)."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return [os.path.join(here, "fast_tffm_tpu")]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    try:
+        findings = run_paths(args or default_paths())
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"fmlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0

@@ -1,0 +1,92 @@
+"""fmlint rules — the hot-loop device-fetch/print invariants.
+
+Scope: HOT_MODULES below — the modules whose loops dispatch (or feed)
+the jitted step stream. Everything else may fetch scalars freely; the
+bench and tools print by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from tools.fmlint.core import Finding
+
+# The hot-loop surface (ISSUE 2 satellite): the train/predict drivers,
+# the batch pipeline, and the whole telemetry layer (obs/ must never
+# cause the stalls it exists to measure).
+HOT_MODULE_SUFFIXES = (
+    "fast_tffm_tpu/train.py",
+    "fast_tffm_tpu/predict.py",
+    "fast_tffm_tpu/data/pipeline.py",
+)
+HOT_PACKAGE_FRAGMENTS = ("fast_tffm_tpu/obs/",)
+
+
+def is_hot_module(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return (p.endswith(HOT_MODULE_SUFFIXES)
+            or any(frag in p for frag in HOT_PACKAGE_FRAGMENTS))
+
+
+def _loops(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            yield node
+
+
+def r001_scalar_fetch(path: str, tree: ast.AST) -> List[Finding]:
+    """float(x)/int(x) inside any loop body, and .item() anywhere, in
+    hot modules: each is a synchronous per-scalar device->host fetch
+    when x is a device array — one such fetch in the hot stream stalls
+    the async dispatch pipeline for seconds over a tunnelled link
+    (measured 528k -> 50k examples/sec). Host-value exceptions carry a
+    justified pragma; bulk paths go through utils/fetch.bulk_fetch."""
+    if not is_hot_module(path):
+        return []
+    found: List[Finding] = []
+    in_loop: set = set()
+    for loop in _loops(tree):
+        for node in ast.walk(loop):
+            in_loop.add(id(node))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Name) and f.id in ("float", "int")
+                and len(node.args) == 1
+                and not isinstance(node.args[0], ast.Constant)
+                and id(node) in in_loop):
+            found.append(Finding(
+                "R001", path, node.lineno,
+                f"{f.id}() in a hot-loop body is a per-scalar device "
+                "fetch if its argument is a device array; buffer and "
+                "bulk_fetch at a barrier, or justify with a pragma"))
+        if (isinstance(f, ast.Attribute) and f.attr == "item"
+                and not node.args):
+            found.append(Finding(
+                "R001", path, node.lineno,
+                ".item() is a per-scalar device fetch on device "
+                "arrays; buffer and bulk_fetch at a barrier, or "
+                "justify with a pragma"))
+    return found
+
+
+def r002_bare_print(path: str, tree: ast.AST) -> List[Finding]:
+    """print() in hot modules: blocks the dispatch loop on stdout and
+    bypasses the logging/telemetry sinks (get_logger / obs)."""
+    if not is_hot_module(path):
+        return []
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            found.append(Finding(
+                "R002", path, node.lineno,
+                "bare print() in a hot-loop module; use "
+                "utils.logging.get_logger or the obs/ sink"))
+    return found
+
+
+RULES = (r001_scalar_fetch, r002_bare_print)

@@ -1,0 +1,120 @@
+"""fmstat — summarize or tail a run's metrics JSONL stream.
+
+The read-side of the obs/ telemetry subsystem:
+
+    python -m tools.fmstat <metrics.jsonl> [more shards...]
+    python -m tools.fmstat --json <metrics.jsonl>
+    python -m tools.fmstat --tail <metrics.jsonl>
+
+Summary mode merges every given file (a multi-process run's chief file
+plus its ``.p<i>`` worker shards — pass a glob) through the registry's
+merge rules (counters add, histograms bucket-merge, gauges per
+process) and renders the same attribution table bench.py's breakdown
+teaches: examples/sec, step-time quantiles, input-wait / pause /
+transfer split, dedup hit rate, padding waste, and a host-bound vs
+device/transfer-bound vs pause-bound verdict. ``--json`` emits the
+merged summary + attribution as one JSON object for scripting.
+``--tail`` follows a live file and pretty-prints events as they land.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import sys
+import time
+from typing import List
+
+from fast_tffm_tpu.obs.attribution import attribution, render, summarize
+from fast_tffm_tpu.obs.sink import read_events
+
+
+def _expand(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        hits = sorted(globlib.glob(p))
+        out.extend(hits if hits else [p])
+    return out
+
+
+def _tail(path: str, out=sys.stdout) -> None:  # pragma: no cover - loop
+    """Follow a live metrics file; one formatted line per event."""
+    with open(path, "r", encoding="utf-8") as fh:
+        while True:
+            line = fh.readline()
+            if not line:
+                time.sleep(0.5)
+                continue
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail mid-write; the rest follows
+            out.write(_format_event(rec) + "\n")
+            out.flush()
+
+
+def _format_event(rec: dict) -> str:
+    ev = rec.get("event", "?")
+    if ev == "metrics":
+        c = rec.get("counters", {})
+        g = rec.get("gauges", {})
+        eps = g.get("train/examples_per_sec_window") or g.get(
+            "predict/examples_per_sec")
+        bits = [f"step={rec.get('step')}"]
+        if eps:
+            bits.append(f"ex/s={eps:,.0f}")
+        for key, label in (("train/examples", "examples"),
+                           ("pipeline/parse_errors", "parse_errs"),
+                           ("pipeline/spilled_batches", "spills")):
+            if c.get(key):
+                bits.append(f"{label}={c[key]:,.0f}")
+        return f"[metrics] {' '.join(bits)}"
+    if ev == "scalar":
+        return (f"[scalar]  {rec.get('name')} step={rec.get('step')} "
+                f"value={rec.get('value'):.6g}")
+    if ev == "run_start":
+        m = rec.get("meta", {})
+        return (f"[run]     kind={m.get('kind')} backend={m.get('backend')} "
+                f"devices={m.get('device_count')} config="
+                f"{m.get('config_hash')} git={m.get('git_rev')}")
+    return f"[{ev}] " + json.dumps(
+        {k: v for k, v in rec.items() if k not in ("event",)},
+        default=str)[:200]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fmstat", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("files", nargs="+",
+                    help="metrics JSONL file(s); globs ok — pass a "
+                         "run's worker shards together to merge them")
+    ap.add_argument("--json", action="store_true",
+                    help="emit merged summary + attribution as JSON")
+    ap.add_argument("--tail", action="store_true",
+                    help="follow the (first) file, print events live")
+    args = ap.parse_args(argv)
+    files = _expand(args.files)
+    if args.tail:
+        try:
+            _tail(files[0])
+        except KeyboardInterrupt:
+            return 0
+        return 0
+    # Fail loudly on unreadable inputs (the repo's loud-failure
+    # mandate); read_events itself tolerates only torn final lines.
+    for f in files:
+        next(iter(read_events(f)), None)
+    summary = summarize(files)
+    if args.json:
+        out = dict(summary)
+        out.pop("scalars", None)
+        out["attribution"] = attribution(summary)
+        print(json.dumps(out, default=str))
+        return 0
+    print(render(summary))
+    return 0
