@@ -16,6 +16,8 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from fast_tffm_tpu.obs.trace import span
+
 
 class CheckpointState:
     """Manages checkpoints under ``<model_file>.ckpt/`` (orbax needs a
@@ -47,57 +49,62 @@ class CheckpointState:
         (orbax's own back-pressure), bounding in-flight state to one
         snapshot. ``wait=True`` — the final/preemption save — blocks
         until the bytes are durably committed before returning."""
-        # Plain python ints for the scalar leaves: orbax's
-        # StandardSave supported types are (int, float, np.ndarray,
-        # jax.Array) — numpy SCALARS (np.int64) are rejected outright
-        # by its save-state validation.
-        payload = {"table": table, "acc": acc,
-                   "step": int(step),
-                   # COMPLETED epochs at save time: lets a restarted
-                   # run resume an interrupted epoch schedule instead
-                   # of rerunning it from zero (train.resume_start_epoch)
-                   "epoch": int(epoch),
-                   "vocab": int(vocabulary_size)}
-        try:
-            self._mngr.save(step, args=ocp.args.StandardSave(payload),
-                            force=force)
-            # A FRESH save at this step carries authoritative metadata:
-            # drop any leftover same-step sidecar (a cleared-and-reused
-            # directory) and any sidecars orphaned by max_to_keep GC —
-            # CheckpointManager doesn't know about them.
-            if jax.process_index() == 0:
-                self._prune_sidecars(fresh_step=step)
-        except ocp.checkpoint_manager.StepAlreadyExistsError:
-            # The final/preemption save can land on the same step as the
-            # last periodic save (save_steps divides the step count).
-            # The ARRAY state at a given step is unique, so that part is
-            # a no-op — but the colliding periodic save recorded the
-            # epoch count as of MID-epoch, while this save may carry the
-            # completed count; without a correction a successfully
-            # completed run restores as "interrupted" and silently
-            # retrains an epoch. The CALLER decides via
-            # rewrite_stale_metadata — train() knows deterministically
-            # (from its own last periodic save) whether the metadata
-            # differs, and a deterministic flag keeps every process of a
-            # multi-host job on the same side of this path (a
-            # per-process disk read here could diverge on one host's
-            # transient error and deadlock the final save). The
-            # correction is a tiny atomically-renamed sidecar holding
-            # the true epoch — restore() overlays it — NOT a
-            # delete+resave of the step: a hard kill here leaves either
-            # the old sidecar state (epoch stale, exactly the status
-            # quo ante — the run retrains one epoch) or the new one;
-            # the step's arrays are never at risk (advisor finding r4).
-            if rewrite_stale_metadata and jax.process_index() == 0:
-                sc = self._epoch_sidecar(step)
-                tmp = sc + ".tmp"
-                with open(tmp, "w") as fh:
-                    fh.write(str(int(epoch)))
-                    fh.flush()
-                    os.fsync(fh.fileno())
-                os.replace(tmp, sc)
-        if wait:
-            self._mngr.wait_until_finished()
+        # Timeline span (obs/trace; no-op without an active
+        # tracing run): checkpoint pauses are a classic silent
+        # stall — the span shows the snapshot cost, `wait=True`
+        # saves show the full write.
+        with span("checkpoint/save", step=int(step), wait=wait):
+            # Plain python ints for the scalar leaves: orbax's
+            # StandardSave supported types are (int, float, np.ndarray,
+            # jax.Array) — numpy SCALARS (np.int64) are rejected outright
+            # by its save-state validation.
+            payload = {"table": table, "acc": acc,
+                       "step": int(step),
+                       # COMPLETED epochs at save time: lets a restarted
+                       # run resume an interrupted epoch schedule instead
+                       # of rerunning it from zero (train.resume_start_epoch)
+                       "epoch": int(epoch),
+                       "vocab": int(vocabulary_size)}
+            try:
+                self._mngr.save(step, args=ocp.args.StandardSave(payload),
+                                force=force)
+                # A FRESH save at this step carries authoritative metadata:
+                # drop any leftover same-step sidecar (a cleared-and-reused
+                # directory) and any sidecars orphaned by max_to_keep GC —
+                # CheckpointManager doesn't know about them.
+                if jax.process_index() == 0:
+                    self._prune_sidecars(fresh_step=step)
+            except ocp.checkpoint_manager.StepAlreadyExistsError:
+                # The final/preemption save can land on the same step as the
+                # last periodic save (save_steps divides the step count).
+                # The ARRAY state at a given step is unique, so that part is
+                # a no-op — but the colliding periodic save recorded the
+                # epoch count as of MID-epoch, while this save may carry the
+                # completed count; without a correction a successfully
+                # completed run restores as "interrupted" and silently
+                # retrains an epoch. The CALLER decides via
+                # rewrite_stale_metadata — train() knows deterministically
+                # (from its own last periodic save) whether the metadata
+                # differs, and a deterministic flag keeps every process of a
+                # multi-host job on the same side of this path (a
+                # per-process disk read here could diverge on one host's
+                # transient error and deadlock the final save). The
+                # correction is a tiny atomically-renamed sidecar holding
+                # the true epoch — restore() overlays it — NOT a
+                # delete+resave of the step: a hard kill here leaves either
+                # the old sidecar state (epoch stale, exactly the status
+                # quo ante — the run retrains one epoch) or the new one;
+                # the step's arrays are never at risk (advisor finding r4).
+                if rewrite_stale_metadata and jax.process_index() == 0:
+                    sc = self._epoch_sidecar(step)
+                    tmp = sc + ".tmp"
+                    with open(tmp, "w") as fh:
+                        fh.write(str(int(epoch)))
+                        fh.flush()
+                        os.fsync(fh.fileno())
+                    os.replace(tmp, sc)
+            if wait:
+                self._mngr.wait_until_finished()
 
     def wait_until_finished(self) -> None:
         self._mngr.wait_until_finished()
@@ -173,23 +180,25 @@ class CheckpointState:
         just to drop it doubles peak host RSS. Uses a read-only
         PyTree-handler manager (StandardSave's on-disk format is the
         PyTree format; partial restore is a PyTreeRestore feature)."""
-        self._mngr.wait_until_finished()
-        s = step if step is not None else self.latest_step()
-        if s is None:
-            return None
-        reader = ocp.CheckpointManager(
-            self.directory, item_handlers=ocp.PyTreeCheckpointHandler())
-        try:
-            restored, err = _restore_tolerating_legacy_epoch(
-                template,
-                lambda t: reader.restore(
-                    s, args=ocp.args.PyTreeRestore(item=t,
-                                                   partial_restore=True)))
-            if err is not None:
-                raise err
-            return self._apply_epoch_override(s, restored)
-        finally:
-            reader.close()
+        with span("checkpoint/restore", partial=True):
+            self._mngr.wait_until_finished()
+            s = step if step is not None else self.latest_step()
+            if s is None:
+                return None
+            reader = ocp.CheckpointManager(
+                self.directory,
+                item_handlers=ocp.PyTreeCheckpointHandler())
+            try:
+                restored, err = _restore_tolerating_legacy_epoch(
+                    template,
+                    lambda t: reader.restore(
+                        s, args=ocp.args.PyTreeRestore(
+                            item=t, partial_restore=True)))
+                if err is not None:
+                    raise err
+                return self._apply_epoch_override(s, restored)
+            finally:
+                reader.close()
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
@@ -201,19 +210,21 @@ class CheckpointState:
         checkpoint exists yet (fresh start). ``template`` is an abstract
         pytree (jax.ShapeDtypeStruct leaves) matching what was saved;
         required by orbax to reconstruct arrays."""
-        self._mngr.wait_until_finished()  # an in-flight async save first
-        s = step if step is not None else self.latest_step()
-        if s is None:
-            return None
-        if template is None:
-            return self._apply_epoch_override(s, self._mngr.restore(s))
-        restored, err = _restore_tolerating_legacy_epoch(
-            template,
-            lambda t: self._mngr.restore(
-                s, args=ocp.args.StandardRestore(t)))
-        if err is not None:
-            self._raise_restore_error(s, err)
-        return self._apply_epoch_override(s, restored)
+        with span("checkpoint/restore"):
+            self._mngr.wait_until_finished()  # in-flight async save first
+            s = step if step is not None else self.latest_step()
+            if s is None:
+                return None
+            if template is None:
+                return self._apply_epoch_override(s,
+                                                  self._mngr.restore(s))
+            restored, err = _restore_tolerating_legacy_epoch(
+                template,
+                lambda t: self._mngr.restore(
+                    s, args=ocp.args.StandardRestore(t)))
+            if err is not None:
+                self._raise_restore_error(s, err)
+            return self._apply_epoch_override(s, restored)
 
     def _raise_restore_error(self, s, e) -> None:
         # Orbax surfaces config-mismatch as a shape ValueError (whose

@@ -129,6 +129,16 @@ class FmConfig:
     # adds file I/O only, never a device fetch); 0 = epoch-only.
     metrics_file: str = ""
     metrics_flush_steps: int = 100
+    # Span timeline tracing (obs/trace.py; needs metrics_file). Off by
+    # default: spans are host-only events at per-batch/per-step cadence
+    # — cheap, but a months-long run doesn't want them unrequested.
+    # Export the stream with tools/fmtrace for ui.perfetto.dev.
+    trace_spans: bool = False
+    # Run-health watchdog (obs/health.py; needs metrics_file). > 0:
+    # a daemon thread emits a `health: stalled` event and dumps
+    # all-thread stacks to <metrics_file>.stacks when no train/predict
+    # step lands for this many seconds. 0 (default) = off.
+    watchdog_stall_seconds: float = 0.0
 
     # --- [Predict] ---------------------------------------------------------
     predict_files: Tuple[str, ...] = ()
@@ -219,6 +229,16 @@ class FmConfig:
             raise ValueError(
                 f"metrics_flush_steps must be >= 0 (0 = flush at epoch "
                 f"barriers only), got {self.metrics_flush_steps}")
+        if self.watchdog_stall_seconds < 0:
+            raise ValueError(
+                f"watchdog_stall_seconds must be >= 0 (0 = watchdog "
+                f"off), got {self.watchdog_stall_seconds}")
+        if self.weight_files and not self.train_files:
+            # Mirror of the validation_weight_files check above: a
+            # sidecar list with nothing to pair against is always a
+            # config mistake, and catching it here beats a silent
+            # no-op (or a late pipeline error) downstream.
+            raise ValueError("weight_files given without train_files")
         if ub and self.max_features_per_example >= ub:
             raise ValueError(
                 f"uniq_bucket ({ub}) must exceed max_features_per_example "
@@ -308,6 +328,8 @@ _TRAIN_KEYS = {
     "profile_num_steps": int,
     "metrics_file": str,
     "metrics_flush_steps": int,
+    "trace_spans": bool,
+    "watchdog_stall_seconds": float,
 }
 _PREDICT_KEYS = {
     "predict_files": _split_files,

@@ -156,6 +156,41 @@ def expand_files(patterns: Sequence[str]) -> List[str]:
     return out
 
 
+def expand_paired_files(patterns: Sequence[str],
+                        sidecar_patterns: Sequence[str]
+                        ) -> Tuple[List[str], List[str]]:
+    """Expand a data-file pattern list and its line-parallel sidecar
+    pattern list TOGETHER, one pattern pair at a time.
+
+    A purely positional zip of the two fully-expanded lists can pair
+    sidecars to the WRONG files while passing a total-length check —
+    e.g. two data patterns against one sidecar pattern whose hit count
+    happens to match (ADVICE round 5). Pairing per pattern (both sides
+    sort within a pattern, as expand_files does) makes parallel naming
+    schemes like ``day*.txt`` / ``day*.weights`` line up by
+    construction, and any per-pattern count mismatch fails loudly with
+    the offending pair named."""
+    if len(sidecar_patterns) != len(patterns):
+        raise ValueError(
+            f"sidecar pattern list must pair 1:1 with its data pattern "
+            f"list ({len(sidecar_patterns)} sidecar patterns vs "
+            f"{len(patterns)} data patterns); write one sidecar "
+            "pattern per data pattern")
+    files: List[str] = []
+    sidecars: List[str] = []
+    for dp, sp in zip(patterns, sidecar_patterns):
+        d = expand_files([dp])
+        s = expand_files([sp])
+        if len(d) != len(s):
+            raise ValueError(
+                f"sidecar pattern pair expands to mismatched counts: "
+                f"{dp!r} -> {len(d)} data files but {sp!r} -> {len(s)} "
+                "sidecars; every data file needs exactly one sidecar")
+        files.extend(d)
+        sidecars.extend(s)
+    return files, sidecars
+
+
 def _ladder_fit(n: int, ladder: Sequence[int]) -> int:
     for b in ladder:
         if n <= b:
@@ -645,12 +680,20 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
         yield from it
         return
     import time as _time
+    from fast_tffm_tpu.obs.trace import span
     pad_id = cfg.pad_id
     while True:
+        # fmlint: disable=R003 -- feeds the pipeline/batch_build_seconds
+        # histogram (always-on aggregate); the span beside it is the
+        # timeline view and is a no-op unless the run traces
         t0 = _time.perf_counter()
-        batch = next(it, None)
+        # span (obs/trace): the same interval, as a timeline event on
+        # the producing (prefetch) thread's track.
+        with span("pipeline/build"):
+            batch = next(it, None)
         if batch is None:
             return
+        # fmlint: disable=R003 -- closes the build-seconds sample
         tel.pipeline_batch(batch, pad_id,
                            build_seconds=_time.perf_counter() - t0)
         yield batch
@@ -685,12 +728,17 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
     from fast_tffm_tpu.data.parser import parse_lines
     from fast_tffm_tpu.data.cparser import parse_lines_fast
 
-    files = expand_files(files)
-    # Sidecars expand too: pairing is positional AFTER expansion (both
-    # lists sort within each pattern), so parallel naming schemes like
-    # day*.txt / day*.weights pair correctly; the count check in
-    # _iter_lines still catches drifted sets.
-    weight_files = expand_files(weight_files) if weight_files else ()
+    if weight_files:
+        # Sidecars expand PER PATTERN PAIR (expand_paired_files): a flat
+        # post-expansion zip can silently pair weights to the wrong
+        # files when multiple patterns are in play; the per-pair count
+        # check fails loudly instead (ADVICE round 5). The count check
+        # in _iter_lines still catches sets drifting between expansion
+        # and open.
+        files, weight_files = expand_paired_files(files, weight_files)
+    else:
+        files = expand_files(files)
+        weight_files = ()
     B = batch_size or cfg.batch_size
     n_epochs = epochs if epochs is not None else (cfg.epoch_num if training
                                                   else 1)
@@ -974,7 +1022,9 @@ def prefetch(iterator: Iterator[DeviceBatch], depth: int = 2,
                 except queue.Full:
                     continue
 
-    threading.Thread(target=worker, daemon=True).start()
+    # Named thread: span events from the pipeline carry the thread name
+    # as their Perfetto track (tools/fmtrace).
+    threading.Thread(target=worker, name="prefetch", daemon=True).start()
     try:
         while True:
             item = q.get()

@@ -42,8 +42,22 @@ def summarize(paths: Sequence[str]) -> Dict[str, Any]:
     last_metrics: Dict[tuple, Dict[str, Any]] = {}
     scalars: List[Dict[str, Any]] = []
     metas: List[Dict[str, Any]] = []
+    health_events: List[Dict[str, Any]] = []
+    crash_events: List[Dict[str, Any]] = []
     n_events = 0
+    n_spans = 0
+    run_starts = 0
+    run_ends = 0
     for path in paths:
+        # Health/crash state is scoped to each file's LATEST run: the
+        # sink appends, so a fixed metrics path accumulates runs — an
+        # old crash must not brand every later clean rerun CRASHED.
+        # Each run_start resets the file-local view; the last segment
+        # is what this file contributes.
+        f_health: List[Dict[str, Any]] = []
+        f_crash: List[Dict[str, Any]] = []
+        f_started = 0
+        f_ended = 0
         for rec in read_events(path):
             n_events += 1
             ev = rec.get("event")
@@ -55,6 +69,20 @@ def summarize(paths: Sequence[str]) -> Dict[str, Any]:
                 scalars.append(rec)
             elif ev == "run_start":
                 metas.append(rec.get("meta") or {})
+                f_health, f_crash = [], []
+                f_started, f_ended = 1, 0
+            elif ev == "run_end":
+                f_ended = 1
+            elif ev == "health":
+                f_health.append(rec)
+            elif ev == "crash":
+                f_crash.append(rec)
+            elif ev == "span":
+                n_spans += 1
+        health_events.extend(f_health)
+        crash_events.extend(f_crash)
+        run_starts += f_started
+        run_ends += f_ended
 
     merged = MetricsRegistry()
     gauges_by_proc: Dict[Any, Dict[str, float]] = {}
@@ -76,6 +104,11 @@ def summarize(paths: Sequence[str]) -> Dict[str, Any]:
         "metas": metas,
         "runs": len(last_metrics),
         "events": n_events,
+        "spans": n_spans,
+        "run_starts": run_starts,
+        "run_ends": run_ends,
+        "health_events": health_events,
+        "crash_events": crash_events,
         "counters": snap["counters"],
         "hists": snap["hists"],
         "gauges": flat_gauges,
@@ -204,6 +237,57 @@ def _bench_verdict(ceil: Dict[str, float]) -> str:
             f"({v:,.0f} ex/s)")
 
 
+def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
+    """The run-health verdict line for one merged summary (obs/health):
+    ``{"verdict": "OK" | "STALLED" | "NONFINITE" | "CRASHED",
+    "detail": ...}``. Read purely from explicit stream events —
+    severity order CRASHED > NONFINITE > STALLED, because a crash ends
+    the run while a survived stall merely delayed it. A stream that
+    never wrote its run_end gets flagged in the detail either way (a
+    hard-killed run writes no crash event; a live run hasn't finished —
+    the reader knows which one it is holding)."""
+    crashes = summary.get("crash_events") or []
+    health = summary.get("health_events") or []
+    stalls = [h for h in health if h.get("status") == "stalled"]
+    recoveries = [h for h in health if h.get("status") == "recovered"]
+    nonfin = [h for h in health
+              if str(h.get("status", "")).startswith("nonfinite")]
+    unclosed = (summary.get("run_starts", 0)
+                > summary.get("run_ends", 0))
+    notes = []
+    if unclosed:
+        notes.append("stream has no run_end (hard kill, or still "
+                     "running)")
+    if crashes:
+        first = crashes[0]
+        err = str(first.get("error", "?"))
+        return {"verdict": "CRASHED",
+                "detail": "; ".join(
+                    [f"{len(crashes)} crash event(s); first: {err[:120]}"]
+                    + notes)}
+    if nonfin:
+        names = sorted({str(h.get("name", "?")) for h in nonfin})
+        lo = min((h.get("step_first") or 0) for h in nonfin)
+        hi = max((h.get("step_last") or 0) for h in nonfin)
+        return {"verdict": "NONFINITE",
+                "detail": "; ".join(
+                    [f"non-finite {', '.join(names)} over steps "
+                     f"{lo}..{hi}"] + notes)}
+    if stalls:
+        worst = max(float(h.get("stalled_seconds") or 0) for h in stalls)
+        rec = (f", recovered x{len(recoveries)}" if recoveries
+               else ", NOT recovered")
+        return {"verdict": "STALLED",
+                "detail": "; ".join(
+                    [f"{len(stalls)} stall episode(s), worst "
+                     f"{worst:.1f}s without progress{rec}; stacks: "
+                     f"{stalls[0].get('stacks_file', '?')}"] + notes)}
+    if unclosed:
+        return {"verdict": "CRASHED", "detail": notes[0]}
+    return {"verdict": "OK", "detail": "no health/crash events; "
+            "run_end present"}
+
+
 def dedup_hit_rate(counters: Dict[str, float]) -> Optional[float]:
     """Fraction of feature occurrences deduplicated away by the host
     unique pass (1 - uniq_rows/nnz). None in raw-ids mode (the unique
@@ -248,7 +332,10 @@ def render(summary: Dict[str, Any]) -> str:
             f"git={meta.get('git_rev', '?')}"]
     lines.append("run: " + " ".join(head))
     lines.append(f"files merged: {summary.get('runs', 0)} run stream(s), "
-                 f"{summary.get('events', 0)} events")
+                 f"{summary.get('events', 0)} events, "
+                 f"{summary.get('spans', 0)} spans")
+    hv = health_verdict(summary)
+    lines.append(f"health: {hv['verdict']} — {hv['detail']}")
     lines.append("")
     rows = [
         ("examples", att["examples"]),

@@ -1,0 +1,172 @@
+"""Run-health watchdog: stall detection, stack forensics, crash events.
+
+The metrics stream (obs/) answers "how fast"; this module answers the
+operational questions aggregates can't: "why is this worker stuck"
+(lockstep multi-worker waits are silent — a hung collective produces
+no event at all), "when did loss go non-finite" (detected at the
+existing barrier bulk-fetch in obs/sink.py — the scalars are already
+host-side there, zero added device fetches), and "what was the run
+doing before it crashed" (the drivers emit a ``crash`` event carrying
+the traceback plus the sink's in-memory ring of recent events).
+
+``Watchdog`` is a daemon thread fed by a heartbeat the train/predict
+loops touch once per step (``RunTelemetry.heartbeat``). The beat is a
+plain tuple assignment — atomic under the GIL, no lock on the hot
+path. When no beat lands within ``stall_seconds`` the watchdog:
+
+- emits a structured ``health`` event (``status = "stalled"``, last
+  step, seconds since the last beat) and flushes the sink so the
+  evidence reaches disk while the run is still wedged (a stalled run
+  never reaches its next barrier);
+- dumps ALL thread stacks via ``faulthandler`` into
+  ``<metrics_file>.stacks`` — the "where is it stuck" answer:
+  a parked ``queue.get``, a hung allgather, a wedged device transfer
+  all show up by name.
+
+One event per stall episode: the watchdog re-arms only after the beat
+resumes (emitting ``status = "recovered"`` with the outage length so
+the timeline shows the gap). Everything here is host-only — the
+watchdog can never add a device fetch to the stream it guards.
+
+Testability: the clock is injected and ``check()`` is callable
+directly, so stall logic is pinned under a fake clock without real
+sleeps; the thread loop is the same ``check()`` on a timer.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import threading
+import time
+from typing import Callable, Optional
+
+# Floor on the poll interval: a tiny stall_seconds must not turn the
+# watchdog into a busy loop.
+MIN_POLL_SECONDS = 0.05
+
+
+class Watchdog:
+    """Daemon-thread stall detector over a run's telemetry sink.
+
+    ``beat(step)`` is the hot-path surface (one tuple assignment);
+    ``check()`` evaluates the stall state once (the thread calls it
+    every ``stall_seconds / 4``); ``start()``/``stop()`` manage the
+    thread. Pass ``clock`` to run the logic under a fake clock."""
+
+    def __init__(self, sink, stall_seconds: float, stacks_path: str,
+                 clock: Callable[[], float] = time.monotonic):
+        self.sink = sink
+        self.stall_seconds = float(stall_seconds)
+        self.stacks_path = stacks_path
+        self._clock = clock
+        # Armed from construction: a run wedged in SETUP (checkpoint
+        # restore against dead storage, a hung distributed bring-up)
+        # stalls before its first step — exactly when forensics are
+        # scarcest.
+        self._beat = (self._clock(), -1)
+        self._stalled_at: Optional[float] = None  # beat time the
+        # current stall episode was declared against (None = healthy)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_events = 0
+
+    # -- hot path --------------------------------------------------------
+    def beat(self, step: Optional[int] = None) -> None:
+        """Record progress. Tuple assignment: atomic under the GIL, so
+        the hot loop never takes a lock for the watchdog."""
+        if step is None:
+            step = self._beat[1]
+        self._beat = (self._clock(), step)
+
+    # -- detection -------------------------------------------------------
+    def check(self) -> Optional[str]:
+        """One stall evaluation; returns the status it emitted ("stalled"
+        / "recovered") or None. The thread calls this on a timer; tests
+        call it directly under a fake clock."""
+        beat_t, beat_step = self._beat
+        now = self._clock()
+        if self._stalled_at is None:
+            if now - beat_t <= self.stall_seconds:
+                return None
+            self._stalled_at = beat_t
+            self.stall_events += 1
+            self.sink.emit("health", {
+                "status": "stalled",
+                "stalled_seconds": now - beat_t,
+                "last_step": beat_step,
+                "stacks_file": self.stacks_path,
+            })
+            self._dump_stacks(now - beat_t, beat_step)
+            # Straight to disk: a stalled run won't reach a barrier.
+            self.sink.flush()
+            return "stalled"
+        if beat_t > self._stalled_at:  # progress resumed
+            outage = beat_t - self._stalled_at
+            self._stalled_at = None
+            self.sink.emit("health", {
+                "status": "recovered",
+                "outage_seconds": outage,
+                "last_step": beat_step,
+            })
+            self.sink.flush()
+            return "recovered"
+        return None
+
+    def _dump_stacks(self, stalled_seconds: float, step: int) -> None:
+        """All-thread stacks into the .stacks sidecar, appended with a
+        header per episode. Never raises into the watchdog loop — a
+        broken dump must not kill stall DETECTION."""
+        try:
+            with open(self.stacks_path, "a", encoding="utf-8") as fh:
+                fh.write(f"\n==== stall after {stalled_seconds:.1f}s "
+                         f"(last step {step}) at {time.time():.3f} "
+                         f"====\n")
+                fh.flush()
+                faulthandler.dump_traceback(file=fh, all_threads=True)
+        except Exception:
+            pass
+
+    # -- thread lifecycle ------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self._thread is None:
+            interval = max(MIN_POLL_SECONDS, self.stall_seconds / 4.0)
+
+            def loop():
+                while not self._stop.wait(interval):
+                    try:
+                        self.check()
+                    except Exception:
+                        pass  # the watchdog must outlive a bad check
+            self._thread = threading.Thread(target=loop, name="watchdog",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+        # Reaching an orderly stop() IS progress — the driver made it
+        # to its close path — so beat once and evaluate a final time.
+        # A stall still open from the last poll (fired during a long
+        # final save, or recovered inside the final interval) closes
+        # out as recovered instead of branding a finished run
+        # 'NOT recovered'. A crashed run's verdict is owned by its
+        # crash event (CRASHED outranks STALLED), and a hard-killed
+        # run never reaches stop() — neither is masked by this.
+        try:
+            self.beat()
+            self.check()
+        except Exception:
+            pass
+
+
+def format_crash(exc: BaseException, limit_chars: int = 8000) -> str:
+    """The traceback text a crash event carries, tail-truncated (the
+    frames nearest the raise are the forensic payload)."""
+    import traceback
+    text = "".join(traceback.format_exception(
+        type(exc), exc, exc.__traceback__))
+    return text[-limit_chars:]

@@ -11,6 +11,24 @@ transfer at ``barrier()`` — the epoch-boundary call — with the same
 performs zero device fetches, so a mid-epoch flush cadence
 (``metrics_flush_steps``) costs file I/O only.
 
+Thread-safety: ``emit``/``flush``/``close`` serialize on one internal
+lock — span events arrive from the prefetch and fetcher worker
+threads, and health events from the watchdog thread, concurrently with
+the driver's flush cadence. ``add_scalar``/``barrier`` stay
+driver-thread-only (they are the device-reference path; see the
+link-safety contract above).
+
+The barrier drain is also the run-health seam for non-finite values
+(obs/health.py): the loss scalars are ALREADY host-side right after
+the one bulk fetch, so checking them there detects NaN/Inf loss with
+zero added device fetches — a ``health`` event with the offending
+name and step range rides the same stream.
+
+Crash forensics: the last ``RING_EVENTS`` emitted events are kept in
+an in-memory ring; the drivers' ``crash`` event embeds that ring, so
+the stream's final line answers "what was the run doing just before
+it died" even when everything since the last flush was lost.
+
 One line per event, ``json.dumps``-encoded. ``metrics`` events carry
 the run metadata dict every time ("one event per flush with run
 metadata"), so any single line is attributable to its run without
@@ -19,8 +37,11 @@ scanning backwards for a header.
 
 from __future__ import annotations
 
+import collections
 import json
+import math
 import os
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
@@ -29,6 +50,14 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 # on a months-long epoch must not retain unbounded device scalars; one
 # rare mid-epoch bulk sync is the lesser evil.
 SCALAR_BUFFER_MAX = 1024
+
+# Host-event buffer cap: spans at per-batch cadence with an epoch-only
+# flush would otherwise grow the buffer for a whole epoch. Hitting the
+# cap forces a plain flush — file I/O only, safe anywhere, any thread.
+EVENT_BUFFER_MAX = 4096
+
+# In-memory ring of recent events embedded in a crash event.
+RING_EVENTS = 32
 
 
 class JsonlSink:
@@ -40,20 +69,45 @@ class JsonlSink:
         os.makedirs(d, exist_ok=True)
         self.path = path
         self.meta = dict(meta or {})
+        self._lock = threading.Lock()
         self._events: List[str] = []
         self._scalars: List[Tuple[str, int, Any]] = []
+        self.recent: "collections.deque" = collections.deque(
+            maxlen=RING_EVENTS)
         self._fh = open(path, "a", encoding="utf-8")
         self._closed = False
+        self._fh_closed = False
         self.emit("run_start", {"meta": self.meta})
 
     def emit(self, event: str, fields: Optional[Dict[str, Any]] = None
              ) -> None:
         """Queue one host-value event (no device arrays — those go
-        through add_scalar). Buffered until flush()."""
+        through add_scalar). Buffered until flush(). Thread-safe: span/
+        health events arrive from worker threads."""
         rec = {"event": event, "t": time.time()}
         if fields:
             rec.update(fields)
-        self._events.append(json.dumps(rec, default=_json_default))
+        line = json.dumps(rec, default=_json_default)
+        overflow = False
+        with self._lock:
+            if self._fh_closed:
+                # A late span from a never-joined daemon thread
+                # (prefetch, fetcher) after run_end: drop it — writing
+                # would raise on the closed handle in that thread.
+                return
+            self._events.append(line)
+            self.recent.append(rec)
+            overflow = len(self._events) >= EVENT_BUFFER_MAX
+        if overflow:
+            self.flush()  # host file I/O only — safe from any thread
+
+    def recent_snapshot(self) -> List[Dict[str, Any]]:
+        """A stable copy of the recent-event ring. Must take the lock:
+        worker threads append concurrently, and iterating a mutating
+        deque raises — which would lose the crash event exactly when
+        it matters."""
+        with self._lock:
+            return list(self.recent)
 
     def emit_metrics(self, step: int, snapshot: Dict[str, Any]) -> None:
         """One metrics event per flush, run metadata included."""
@@ -62,7 +116,8 @@ class JsonlSink:
 
     def add_scalar(self, name: str, step: int, value: Any) -> None:
         """Queue one scalar whose value may be a DEVICE array; it is
-        not fetched here — barrier() bulk-fetches the whole buffer."""
+        not fetched here — barrier() bulk-fetches the whole buffer.
+        Driver-thread-only (the device-reference path)."""
         self._scalars.append((name, int(step), value))
         if len(self._scalars) >= SCALAR_BUFFER_MAX:
             self._drain_scalars()
@@ -70,10 +125,14 @@ class JsonlSink:
     def flush(self) -> None:
         """Write buffered events to disk. ZERO device fetches: queued
         device scalars stay queued until the next barrier()."""
-        if self._events:
-            self._fh.write("\n".join(self._events) + "\n")
-            self._events.clear()
-        self._fh.flush()
+        with self._lock:
+            if self._fh_closed:
+                self._events.clear()
+                return
+            events, self._events = self._events, []
+            if events:
+                self._fh.write("\n".join(events) + "\n")
+            self._fh.flush()
 
     def _drain_scalars(self) -> None:
         if not self._scalars:
@@ -87,8 +146,26 @@ class JsonlSink:
                    lambda v, m: rows.append(
                        (m[0], m[1], float(v))))  # host array post-fetch
         self._scalars.clear()
+        bad: Dict[str, List[int]] = {}
         for name, step, val in rows:
             self.emit("scalar", {"name": name, "step": step, "value": val})
+            # Only LOSS scalars escalate to a health event: a NaN
+            # validation AUC is a legitimate value (a shard with no
+            # positives or no negatives — StreamingAUC.result), and
+            # flagging it would mark healthy runs NONFINITE. The raw
+            # scalar event above still records it for forensics.
+            if "loss" in name and not math.isfinite(val):
+                bad.setdefault(name, []).append(step)
+        # Non-finite detection rides the fetch that just happened: the
+        # values are host floats here, so this costs zero extra device
+        # traffic (obs/health.py's contract).
+        for name, steps in bad.items():
+            self.emit("health", {
+                "status": "nonfinite_loss",
+                "name": name,
+                "step_first": min(steps), "step_last": max(steps),
+                "count": len(steps),
+            })
 
     def barrier(self) -> None:
         """Epoch/shutdown barrier: bulk-fetch queued device scalars into
@@ -108,7 +185,13 @@ class JsonlSink:
             self.emit("run_end", {})
             self.flush()
         finally:
-            self._fh.close()
+            # Close the handle UNDER the lock and flag it first: a
+            # worker-thread emit/flush racing this sequence sees the
+            # flag and drops its event instead of writing to (or
+            # overflowing into) a closed file.
+            with self._lock:
+                self._fh_closed = True
+                self._fh.close()
 
 
 def _json_default(o: Any):

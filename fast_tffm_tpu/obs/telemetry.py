@@ -118,12 +118,24 @@ class RunTelemetry:
     """
 
     def __init__(self, path: str, meta: Dict[str, Any],
-                 flush_steps: int = 0):
+                 flush_steps: int = 0, trace_spans: bool = False,
+                 watchdog_stall_seconds: float = 0.0):
         self.registry = MetricsRegistry()
         self.sink = JsonlSink(path, meta=meta)
         self.flush_steps = max(0, int(flush_steps))
         self._last_flush = time.perf_counter()
         self._closed = False
+        # Span tracing (obs/trace.py): span() reads this flag through
+        # active(), so the off cost at every site stays one global read.
+        self.trace_spans = bool(trace_spans)
+        # Run-health watchdog (obs/health.py): a daemon thread fed by
+        # heartbeat(); owns the stall/stack-dump forensics.
+        self.watchdog = None
+        if watchdog_stall_seconds and watchdog_stall_seconds > 0:
+            from fast_tffm_tpu.obs.health import Watchdog
+            self.watchdog = Watchdog(
+                self.sink, watchdog_stall_seconds,
+                stacks_path=path + ".stacks").start()
 
     # -- registry passthroughs (the instrumented-site surface) ----------
     def count(self, name: str, n: float = 1.0) -> None:
@@ -140,6 +152,29 @@ class RunTelemetry:
         barrier; never fetches here."""
         self.sink.add_scalar(name, step, value)
 
+    def heartbeat(self, step: Optional[int] = None) -> None:
+        """Touch the watchdog's progress beat — the train/predict loops
+        call this once per step. No watchdog configured: one attribute
+        read and out."""
+        w = self.watchdog
+        if w is not None:
+            w.beat(step)
+
+    def record_crash(self, exc: BaseException, step: int = -1) -> None:
+        """Write the stream's final forensic event before the sink
+        closes: exception type/message, traceback tail, and the ring of
+        recent in-memory events (obs/sink.RING_EVENTS) — the "what was
+        it doing just before" answer for a crashed run."""
+        from fast_tffm_tpu.obs.health import format_crash
+        recent = self.sink.recent_snapshot()
+        self.sink.emit("crash", {
+            "error": f"{type(exc).__name__}: {exc}",
+            "traceback": format_crash(exc),
+            "step": int(step),
+            "recent_events": recent,
+        })
+        self.sink.flush()
+
     # -- flush cadence --------------------------------------------------
     def flush_due(self, step: int) -> bool:
         return bool(self.flush_steps) and step % self.flush_steps == 0
@@ -150,8 +185,12 @@ class RunTelemetry:
             self.sink.flush()
 
     def barrier_flush(self, step: int) -> None:
-        self._emit_metrics(step)
-        self.sink.barrier()
+        from fast_tffm_tpu.obs.trace import span
+        self.heartbeat(step)  # a barrier IS progress — don't let a long
+        # epoch-end fetch read as a stall
+        with span("obs/barrier_flush", step=step):
+            self._emit_metrics(step)
+            self.sink.barrier()
 
     def _emit_metrics(self, step: int) -> None:
         now = time.perf_counter()
@@ -163,6 +202,10 @@ class RunTelemetry:
         if self._closed:
             return
         self._closed = True
+        if self.watchdog is not None:
+            # Stop BEFORE the final emit/close: a watchdog firing into
+            # a closing sink would race the file handle.
+            self.watchdog.stop()
         if step >= 0:
             self._emit_metrics(step)
         else:
@@ -230,8 +273,14 @@ def make_telemetry(cfg, kind: str) -> Optional[RunTelemetry]:
     path = resolve_metrics_path(cfg)
     if path is None:
         return None
-    return RunTelemetry(path, meta=run_meta(cfg, kind),
-                        flush_steps=cfg.metrics_flush_steps)
+    # getattr defaults: tests (and bench) build pared-down cfg objects
+    # that predate the tracing/watchdog knobs.
+    return RunTelemetry(
+        path, meta=run_meta(cfg, kind),
+        flush_steps=cfg.metrics_flush_steps,
+        trace_spans=getattr(cfg, "trace_spans", False),
+        watchdog_stall_seconds=getattr(cfg, "watchdog_stall_seconds",
+                                       0.0))
 
 
 def batch_payload_bytes(args: Dict[str, Any]) -> int:

@@ -1,0 +1,85 @@
+"""Span timeline tracing over the telemetry JSONL stream.
+
+``span("train/step")`` brackets one stage of a run and emits a ``span``
+event (wall-clock start, duration, thread) into the active run's sink.
+The aggregate metrics (obs/registry) say a run is slow; spans say where
+a SPECIFIC step's time went — and because they ride the same JSONL
+stream as everything else, ``tools/fmtrace`` can replay a whole run
+(all worker shards, one track per process, one row per thread) in
+ui.perfetto.dev.
+
+Cost discipline — the same one as ``telemetry.active()``:
+
+- no active run, or ``trace_spans`` off (the default): ``span()`` is
+  ONE module-global read + one attribute read, and returns a shared
+  ``contextlib.nullcontext`` — no allocation, nothing timed. Hot loops
+  may therefore call it unconditionally (and fmlint R003 pushes them
+  to, instead of hand-rolled ``perf_counter`` pairs).
+- tracing on: two clock reads plus one buffered ``sink.emit`` per
+  span. Host values only — a span can NEVER cause a device fetch, so
+  enabling tracing preserves the zero-mid-stream-fetch contract
+  (pinned by tests/test_health_trace.py).
+
+Spans nest by time containment: Perfetto draws an inner span inside
+its enclosing one when both ran on the same (pid, tid) track, so no
+explicit parent ids are needed — the thread name IS the track.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from fast_tffm_tpu.obs import telemetry as _telemetry
+
+# Shared no-op context: nullcontext instances are stateless and
+# reentrant, so every inactive span() returns this one object.
+_NULL = contextlib.nullcontext()
+
+
+def span(name: str, **fields):
+    """Context manager timing one stage into the active run's stream.
+
+    ``fields`` (step/epoch/path/...) land verbatim on the span event.
+    Returns a shared no-op when no run is active or the run was not
+    created with ``trace_spans`` — the default-off cost at every
+    instrumented site is one module-global read."""
+    tel = _telemetry.active()
+    if tel is None or not getattr(tel, "trace_spans", False):
+        return _NULL
+    return _Span(tel.sink, name, fields or None)
+
+
+class _Span:
+    """One live span: wall start at enter, duration at exit, emitted as
+    a single buffered host-value event. ``perf_counter`` for the
+    duration (monotonic), ``time.time`` for the start (the cross-
+    process alignment fmtrace needs to line worker tracks up)."""
+
+    __slots__ = ("_sink", "_name", "_fields", "_wall", "_t0")
+
+    def __init__(self, sink, name: str,
+                 fields: Optional[Dict[str, Any]]):
+        self._sink = sink
+        self._name = name
+        self._fields = fields
+
+    def __enter__(self) -> "_Span":
+        self._wall = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur = time.perf_counter() - self._t0
+        rec = {"name": self._name, "ts": self._wall, "dur": dur,
+               "tid": threading.current_thread().name}
+        if self._fields:
+            rec.update(self._fields)
+        if exc_type is not None:
+            # A span cut by an exception is exactly the one forensics
+            # wants flagged on the timeline.
+            rec["error"] = exc_type.__name__
+        self._sink.emit("span", rec)
+        return False

@@ -168,8 +168,23 @@ def fm_batch_scores_pallas(params: jax.Array, local_idx: jax.Array,
     # check_vma=False: pallas_call declares no varying-mesh-axes rule;
     # the body is per-example with zero collectives, so the manual specs
     # are the whole contract.
-    fn = jax.shard_map(
-        fm_scores_pallas, mesh=mesh,
+    fn = _shard_map(
+        fm_scores_pallas, mesh,
         in_specs=(P("data", None, None), P("data", None), P("data", None)),
-        out_specs=P("data"), check_vma=False)
+        out_specs=P("data"))
     return fn(v, w, vals)
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the API move: top-level (new jax, where
+    the replication-check kwarg is ``check_vma``) or
+    ``jax.experimental.shard_map`` (older installs, where it is
+    ``check_rep``). Both flags express the same opt-out: pallas_call
+    declares no replication rule, so the manual specs are the whole
+    contract."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)

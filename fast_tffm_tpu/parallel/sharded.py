@@ -345,6 +345,7 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     from fast_tffm_tpu.data.pipeline import empty_batch
     from fast_tffm_tpu.models.fm import batch_args
     from fast_tffm_tpu.obs.telemetry import active
+    from fast_tffm_tpu.obs.trace import span
     tel = active()  # per-worker lockstep telemetry (obs/): each
     # process counts its own rounds/fillers/examples into its own
     # sink shard; fmstat merges the streams keyed by process index
@@ -356,16 +357,25 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     while True:
         window = []
         t_fill = _time.perf_counter()
-        while len(window) < LOCKSTEP_WINDOW:
-            if max_batches and n_real + len(window) >= max_batches:
-                break
-            b = next(it, None)
-            if b is None:
-                break
-            window.append(b)
-        fills = multihost_utils.process_allgather(
-            np.asarray([len(window)]))
+        with span("lockstep/window_fill"):
+            while len(window) < LOCKSTEP_WINDOW:
+                if max_batches and n_real + len(window) >= max_batches:
+                    break
+                b = next(it, None)
+                if b is None:
+                    break
+                window.append(b)
+        # The silent multi-worker wait: a peer still filling (or hung)
+        # parks everyone here. The span makes the wait VISIBLE on the
+        # timeline; if it never returns, the heartbeat below has gone
+        # quiet and the watchdog's stack dump names this allgather
+        # (obs/health.py).
+        with span("lockstep/allgather", window=len(window)):
+            fills = multihost_utils.process_allgather(
+                np.asarray([len(window)]))
         rounds = int(fills.max())
+        if tel is not None:
+            tel.heartbeat()  # a completed collective is progress
         if tel is not None and rounds:
             tel.count("lockstep/windows")
             # Collective programs this round == the window max across
@@ -402,12 +412,17 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         if tel is not None:
             tel.count("lockstep/examples",
                       sum(b.num_real for b in window))
-        for batch, score in pending:
+        # Round-end bulk fetch: every queued score vector materializes
+        # host-side here (the deferred D2H the window exists to
+        # amortize) — one span for the whole drain.
+        with span("lockstep/score_fetch", batches=len(pending)):
+            fetched = [(batch, local_rows(score))
+                       for batch, score in pending]
+        for batch, local in fetched:
             # This process's rows of the global [B_global] score vector
             # are exactly its local batch (global_batch concatenates
             # local batches in process order over process-contiguous
             # data-axis devices); local_rows dedups model-axis replicas.
-            local = local_rows(score)
             assert len(local) == len(batch.labels), (
                 f"local score slice {len(local)} != local batch "
                 f"{len(batch.labels)}")

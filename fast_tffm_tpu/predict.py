@@ -25,6 +25,7 @@ from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
                                      make_batch_scorer, ships_raw_batches)
 from fast_tffm_tpu.obs.telemetry import (active, make_telemetry,
                                          pop_active, push_active)
+from fast_tffm_tpu.obs.trace import span
 from fast_tffm_tpu.utils.fetch import ChunkedFetcher
 from fast_tffm_tpu.utils.logging import get_logger
 
@@ -80,24 +81,34 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     fetcher = ChunkedFetcher(lambda s, num_real: out.append(s[:num_real]),
                              overlap=True)
     tel = active()
-    for batch in prefetch(batch_iterator(cfg, files, training=False,
-                                         epochs=1, keep_empty=True,
-                                         raw_ids=raw),
-                          depth=cfg.prefetch_depth,
-                          gil_bound=gil_bound_iteration(
-                              cfg, keep_empty=True)):
-        args = batch_args(batch)
-        args.pop("labels"), args.pop("weights")
-        fetcher.add(score_fn(table, args), batch.num_real)
-        if tel is not None:
-            tel.count("predict/batches")
-            tel.count("predict/examples", batch.num_real)
-            # Output-order buffer: device score arrays held back so
-            # results land in input order — its depth is the D2H
-            # backlog (BASELINE.md "Predict-path rate").
-            tel.observe("predict/fetch_depth", fetcher.pending_depth,
-                        bounds=_DEPTH_BUCKETS)
-    fetcher.flush()
+    # try/finally (ADVICE round 5): an exception mid-sweep must not
+    # leave the overlap worker parked on queue.get forever with a
+    # queued chunk of device score arrays pinned in HBM — close()
+    # drains and joins the worker without masking the original error.
+    try:
+        for batch in prefetch(batch_iterator(cfg, files, training=False,
+                                             epochs=1, keep_empty=True,
+                                             raw_ids=raw),
+                              depth=cfg.prefetch_depth,
+                              gil_bound=gil_bound_iteration(
+                                  cfg, keep_empty=True)):
+            args = batch_args(batch)
+            args.pop("labels"), args.pop("weights")
+            fetcher.add(score_fn(table, args), batch.num_real)
+            if tel is not None:
+                tel.count("predict/batches")
+                tel.count("predict/examples", batch.num_real)
+                # Output-order buffer: device score arrays held back so
+                # results land in input order — its depth is the D2H
+                # backlog (BASELINE.md "Predict-path rate").
+                tel.observe("predict/fetch_depth", fetcher.pending_depth,
+                            bounds=_DEPTH_BUCKETS)
+                # Watchdog beat: a scored batch is progress
+                # (obs/health.py).
+                tel.heartbeat()
+        fetcher.flush()
+    finally:
+        fetcher.close()
     return (np.concatenate(out) if out
             else np.zeros(0, dtype=np.float32))
 
@@ -128,6 +139,16 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
     try:
         written = _predict_body(cfg, table, logger)
         return written
+    except BaseException as e:
+        # Crash forensics (obs/health.py): traceback + recent-event
+        # ring as the stream's last substantive event; the finally
+        # still closes the sink so run_end terminates the stream.
+        if tel is not None:
+            try:
+                tel.record_crash(e)
+            except Exception:
+                logger.exception("crash event emission failed")
+        raise
     finally:
         if tel is not None:
             try:
@@ -182,9 +203,14 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
     os.makedirs(cfg.score_path, exist_ok=True)
     written = []
     for path in expand_files(cfg.predict_files):
+        # fmlint: disable=R003 -- feeds the predict/seconds counter and
+        # per-file rate gauge (always-on aggregates; the span beside it
+        # is the timeline view)
         t0 = time.perf_counter()
-        raw = predict_scores(cfg, table, [path], mesh=mesh,
-                             backend=backend)
+        with span("predict/file", path=os.path.basename(path)):
+            raw = predict_scores(cfg, table, [path], mesh=mesh,
+                                 backend=backend)
+        # fmlint: disable=R003 -- closes the predict/seconds sample
         dt = time.perf_counter() - t0
         vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
         out_path = os.path.join(cfg.score_path,
@@ -238,6 +264,8 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
     tel = active()
     written: List[str] = []
     for path in expand_files(cfg.predict_files):
+        # fmlint: disable=R003 -- feeds the per-worker predict/seconds
+        # counter (always-on aggregate)
         t0 = time.perf_counter()
         # Deterministic probe: every process reads the same bytes, so
         # all agree on U without a collective.
@@ -246,9 +274,14 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
                             keep_empty=True, shard_index=p, num_shards=P,
                             fixed_shape=True, uniq_bucket=ub)
         local: List[np.ndarray] = []
-        for batch, scores in lockstep_score_batches(cfg, it, mesh,
-                                                    score_fn, table, ub):
-            local.append(scores[:batch.num_real])
+        with span("predict/file", path=os.path.basename(path)):
+            for batch, scores in lockstep_score_batches(cfg, it, mesh,
+                                                        score_fn, table,
+                                                        ub):
+                local.append(scores[:batch.num_real])
+                if tel is not None:
+                    tel.heartbeat()  # lockstep progress feeds the
+                    # watchdog; a hung peer stalls the whole cluster
         raw = (np.concatenate(local) if local
                else np.zeros(0, dtype=np.float32))
         vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
@@ -284,6 +317,7 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
             # Per-WORKER rate for this worker's shard; the merged view
             # (fmstat over all .p<i> shards) sums examples and seconds
             # across processes, keyed by process index in the metadata.
+            # fmlint: disable=R003 -- closes the predict/seconds sample
             dt = time.perf_counter() - t0
             n_local = len(raw)
             tel.count("predict/seconds", dt)

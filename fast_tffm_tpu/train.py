@@ -31,9 +31,10 @@ from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
                                      make_train_step, ships_raw_batches)
-from fast_tffm_tpu.obs.telemetry import (batch_payload_bytes,
+from fast_tffm_tpu.obs.telemetry import (active, batch_payload_bytes,
                                          make_telemetry, pop_active,
                                          push_active)
+from fast_tffm_tpu.obs.trace import span
 from fast_tffm_tpu.utils.fetch import ChunkedFetcher, bulk_fetch
 from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.timing import StepTimer, trace_span
@@ -74,23 +75,35 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
         lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]],
                                      m[2][:m[1]]),
         overlap=True)  # D2H of chunk N overlaps scoring of chunk N+1
-    for batch in prefetch(batch_iterator(cfg, files, training=False,
-                                         weight_files=weight_files,
-                                         epochs=1, raw_ids=raw),
-                          depth=cfg.prefetch_depth,
-                          gil_bound=gil_bound_iteration(cfg,
-                                                        weight_files)):
-        args = batch_args(batch)
-        args.pop("labels"), args.pop("weights")
-        fetcher.add(score_fn(table, args),
-                    (batch.labels, batch.num_real, batch.weights))
-        n += batch.num_real
-        n_batches += 1
-        # Batch-count cap — the same per-input-shard unit the
-        # distributed path uses, so AUC samples are comparable.
-        if max_batches and n_batches >= max_batches:
-            break
-    fetcher.flush()
+    tel = active()
+    # try/finally (ADVICE round 5): an exception mid-sweep must not
+    # leave the overlap worker parked on queue.get forever with a
+    # queued chunk of device score arrays pinned in HBM — close()
+    # drains and joins the worker without masking the original error.
+    try:
+        for batch in prefetch(batch_iterator(cfg, files, training=False,
+                                             weight_files=weight_files,
+                                             epochs=1, raw_ids=raw),
+                              depth=cfg.prefetch_depth,
+                              gil_bound=gil_bound_iteration(
+                                  cfg, weight_files)):
+            args = batch_args(batch)
+            args.pop("labels"), args.pop("weights")
+            fetcher.add(score_fn(table, args),
+                        (batch.labels, batch.num_real, batch.weights))
+            n += batch.num_real
+            n_batches += 1
+            if tel is not None:
+                # A full validation sweep can outlast the watchdog's
+                # stall budget; scored batches are progress.
+                tel.heartbeat()
+            # Batch-count cap — the same per-input-shard unit the
+            # distributed path uses, so AUC samples are comparable.
+            if max_batches and n_batches >= max_batches:
+                break
+        fetcher.flush()
+    finally:
+        fetcher.close()
     return auc.result(), n
 
 
@@ -207,240 +220,254 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             "paths rely on the host-side unique contract (fixed-U "
             "buckets, global_batch local_idx offsets)")
 
-    uniq_bucket = 0
-    if multi_process:
-        # Fixed-shape batches need one U for the whole job. Auto mode
-        # measures the data (probe is deterministic and identical on
-        # every process) instead of assuming the next_pow2(B*L) worst
-        # case — a ~50x smaller gather/scatter per step at Criteo-like
-        # density; denser-than-probed batches spill, never break.
-        from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
-        uniq_bucket = cfg.uniq_bucket or probe_uniq_bucket(
-            cfg, cfg.train_files)
-        logger.info("fixed unique-row bucket: %d", uniq_bucket)
-    val_bucket = 0
-    if multi_process and cfg.validation_files:
-        val_bucket = cfg.uniq_bucket or probe_uniq_bucket(
-            cfg, cfg.validation_files)
-
-    ckpt = CheckpointState(cfg.model_file)
-    global_step = 0
-    restored = ckpt.restore(
-        template=checkpoint_template(cfg, mesh, host=offload))
-    restored_epoch = 0
-    if restored is not None:
-        check_restored_vocab(cfg, restored)
-        global_step = int(restored["step"])
-        restored_epoch = int(restored["epoch"])
-        logger.info("restored checkpoint at step %d", global_step)
-    restored_step = global_step
-    start_epoch = resume_start_epoch(restored_epoch, cfg.epoch_num)
-    if start_epoch:
-        logger.info("resuming interrupted epoch schedule at epoch %d/%d",
-                    start_epoch, cfg.epoch_num)
-    lk = None
-    if offload:
-        # Offload backend (lookup.py; BASELINE config #5): the table/
-        # accumulator live outside HBM. make_offload_backend picks the
-        # in-jit pinned-host implementation (whole step stays in the
-        # async dispatch stream) where the backend compiles it, else the
-        # numpy fallback with its inherent per-step gradient fetch.
-        from fast_tffm_tpu.lookup import (PinnedHostLookup,
-                                          make_offload_backend,
-                                          make_offload_train_step)
-        lk = make_offload_backend(cfg, cfg.seed, restored=restored)
-        if restored is not None:
-            # The backend adopted the arrays (numpy backend: zero-copy)
-            # or copied them into accelerator-host memory (pinned
-            # backend); keeping these references for the rest of
-            # train() would pin a SECOND full table+accumulator in
-            # local RAM for the whole resumed run — a sustained 2x that
-            # is an OOM at config-#5 scale (the same concern
-            # HostOffloadLookup.load documents for transient copies).
-            restored["table"] = restored["acc"] = None
-        kind = (f"pinned-host in-jit ({lk.mode})"
-                if isinstance(lk, PinnedHostLookup) else "host-numpy")
-        logger.info("offload lookup [%s]: table [%d, %d] outside HBM "
-                    "(%.2f GB + accumulator)", kind, lk.rows, lk.dim,
-                    lk.rows * lk.dim * 4 / 2**30)
-        offload_step = make_offload_train_step(spec, lk,
-                                               cfg.learning_rate)
-        table = acc = None
-
-        def step_fn(_t, _a, labels, weights, uniq_ids, local_idx, vals,
-                    fields=None):
-            loss, scores = offload_step(labels, weights, uniq_ids,
-                                        local_idx, vals, fields)
-            return None, None, loss, scores
-    elif mesh is not None:
-        if restored is not None:
-            # The sharded template already placed these row-sharded on
-            # this mesh in the runtime [ckpt_rows, D] layout — use as-is.
-            table, acc = restored["table"], restored["acc"]
-        else:
-            table, acc = init_sharded_state(cfg, mesh, cfg.seed)
-        step_fn = make_sharded_train_step(spec, mesh)
-    else:
-        if restored is not None:
-            table = restored["table"][:cfg.num_rows]
-            acc = restored["acc"][:cfg.num_rows]
-            # The slices above are NEW device buffers; drop the full
-            # [ckpt_rows, D] restored arrays so they free once the
-            # slice completes — holding them for the whole run is a
-            # sustained ~2x HBM cost that only bites on resume.
-            restored["table"] = restored["acc"] = None
-        else:
-            table = init_table(cfg, cfg.seed)
-            acc = init_accumulator(cfg)
-        step_fn = make_train_step(spec)
-
-    # Preemption handling (SURVEY §5 "Failure detection": the reference
-    # only recovers via restart+restore; we additionally save on the way
-    # down). SIGTERM/SIGINT sets a flag the loop drains at the next step
-    # boundary — in multi-process mode the flag rides the lockstep
-    # allgather so every process saves/exits together even when only one
-    # received the signal.
-    preempted: list = []
-    prev_handlers = {}
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        try:
-            prev_handlers[sig] = signal.signal(
-                sig, lambda s, f: preempted.append(s))
-        except ValueError:  # not the main thread (e.g. under a test)
-            pass
-
-    profiling = False
-    run_start_step = global_step  # profile window counts THIS run's steps
-    # (a resumed job would otherwise skip past the window silently)
-
-    def profile_tick(step_done: int) -> None:
-        nonlocal profiling
-        if not cfg.profile_dir or jax.process_index() != 0:
-            return
-        step_done -= run_start_step
-        if (not profiling and step_done >= cfg.profile_start_step
-                and step_done < cfg.profile_start_step
-                + cfg.profile_num_steps):
-            jax.profiler.start_trace(cfg.profile_dir)
-            profiling = True
-        elif profiling and step_done >= (cfg.profile_start_step
-                                         + cfg.profile_num_steps):
-            if table is not None:
-                jax.block_until_ready(table)
-            jax.profiler.stop_trace()
-            profiling = False
-            logger.info("profiler trace written to %s", cfg.profile_dir)
-
-    timer = StepTimer()
-    loss = None
-    loss_val = float("nan")
-    stopping = False
-    last_val = None  # (auc, n) of the most recent validation pass
-
     # Run telemetry (obs/; metrics_file knob): counters/gauges/
     # histograms flushed as JSONL. Every process writes its own shard
     # file; device scalars (loss) buffer and bulk-fetch only at epoch
     # barriers — same link-safety discipline as summaries/log_buffer.
+    # Created BEFORE the input probe / checkpoint restore / offload
+    # bring-up so setup is inside the stream too: a run wedged
+    # restoring against dead storage stalls the watchdog, and a setup
+    # crash still writes its crash event (obs/health.py forensics).
     tel = make_telemetry(cfg, "train")
     if tel is not None:
         logger.info(
             "writing run metrics to %s (flush every %s steps; summarize "
             "with: python -m tools.fmstat %s)", tel.sink.path,
             tel.flush_steps or "epoch", tel.sink.path)
-
-    # TensorBoard scalars (save_summaries_steps; utils/summaries.py).
-    # Chief-only, and flushed ONLY at epoch barriers: values buffer as
-    # device scalars so the cadence adds zero mid-stream fetches.
+    # Names the finally below reads; they must exist even when setup
+    # raises before reaching their real definitions.
     summaries = None
-    if cfg.save_summaries_steps and jax.process_index() == 0:
-        from fast_tffm_tpu.utils.summaries import make_summaries
-        summaries = make_summaries(cfg)
-        if summaries is not None:
-            logger.info("writing TensorBoard summaries every %d steps "
-                        "to %s", cfg.save_summaries_steps,
-                        summaries.logdir)
+    profiling = False
+    prev_handlers = {}
+    global_step = 0
 
-    # Adaptive loss logging. float(loss) is a synchronous device->host
-    # fetch; on direct-attached devices it costs microseconds, but over
-    # a proxied/tunnelled device link ANY mid-stream fetch stalls the
-    # async dispatch pipeline catastrophically (measured here: ONE
-    # scalar fetch in a hot stream costs seconds, 528k -> 50k
-    # examples/sec even at a 1/25-step cadence; copy_to_host_async is
-    # just as bad). So the first log step measures the fetch once: if
-    # it is cheap, logging stays live (the normal-hardware behavior);
-    # if not, loss values are buffered ON DEVICE (scalars) and flushed
-    # at epoch boundaries — a natural barrier — with correct per-step
-    # attribution.
-    # Probe the link BEFORE the hot loop, with an empty dispatch queue:
-    # a mid-stream probe on a slow link costs seconds (it drains the
-    # queue through the slow path — measured ~10 s at step 61 of a
-    # criteo-shaped run) where this costs one clean round-trip.
-    def _probe_link() -> str:
-        import time as _time
-        if cfg.log_steps <= 0:
-            return "deferred"  # mode never consulted without log lines
-        probe = jax.device_put(np.float32(0.0))
-        jax.block_until_ready(probe)
-        float(probe)  # throwaway: lazy transfer-path init stays untimed
-        cost = float("inf")
-        for _ in range(3):  # min of 3: jitter must not misclassify
-            t0 = _time.perf_counter()
-            # fmlint: disable=R001 -- this IS the link probe: one
-            # deliberate timed scalar fetch, before the hot loop starts
-            float(probe)
-            cost = min(cost, _time.perf_counter() - t0)
-        if cost < LIVE_FETCH_BUDGET_S:
-            # Log the decision either way: a user wondering why loss
-            # lines are (or aren't) live gets the probe's answer.
-            logger.info("scalar fetch costs %.3f ms on this device link; "
-                        "loss log lines stay live", cost * 1e3)
-            return "live"
-        logger.info(
-            "scalar fetch costs %.0f ms on this device link; deferring "
-            "loss log lines to epoch boundaries to keep the dispatch "
-            "pipeline hot", cost * 1e3)
-        return "deferred"
+    def flush_log():  # rebound once the deferred log buffer exists
+        pass
 
-    log_mode = _probe_link()
-    log_buffer: list = []    # deferred: (step, epoch, loss_arr, eps)
-
-    def log_line(s, ep, val, eps):
-        nonlocal loss_val
-        loss_val = val
-        logger.info("step %d epoch %d loss %.6f examples/sec %.0f",
-                    s, ep, val, eps)
-
-    def log_tick(s, ep, loss_arr, eps):
-        if log_mode == "deferred":
-            log_buffer.append((s, ep, loss_arr, eps))
-            # Bound the buffer: log_steps=1 on a months-long epoch must
-            # not retain unbounded device scalars; one rare mid-epoch
-            # sync is the lesser evil.
-            if len(log_buffer) >= LOG_BUFFER_MAX:
-                flush_log()
-            return
-        log_line(s, ep, float(loss_arr), eps)
-
-    def flush_log():
-        if not log_buffer:
-            return
-        # bulk_fetch stacks the same-shaped scalars into ONE transfer:
-        # deferred mode is only ever active on a slow device link,
-        # where a per-element list fetch costs ~200 ms EACH
-        # (utils/fetch.py) — a full 1024-entry buffer would stall for
-        # minutes.
-        bulk_fetch([(arr, (s, ep, eps))
-                    for s, ep, arr, eps in log_buffer],
-                   lambda v, m: log_line(m[0], m[1], float(v), m[2]))
-        log_buffer.clear()
-    # Handlers stay installed (absorbing re-signals) until the finally
-    # below — i.e. until the final checkpoint/export is safely on disk,
-    # the window a second SIGTERM is most likely to arrive in. The
-    # finally also covers exceptions, so a failed in-process train()
-    # can't leave the surviving process (pytest, REPL, server) with
-    # SIGTERM/SIGINT swallowed into a dead flag list.
     tel_prev = push_active(tel)  # popped in the finally, crash or not
     try:
+        uniq_bucket = 0
+        if multi_process:
+            # Fixed-shape batches need one U for the whole job. Auto mode
+            # measures the data (probe is deterministic and identical on
+            # every process) instead of assuming the next_pow2(B*L) worst
+            # case — a ~50x smaller gather/scatter per step at Criteo-like
+            # density; denser-than-probed batches spill, never break.
+            from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
+            uniq_bucket = cfg.uniq_bucket or probe_uniq_bucket(
+                cfg, cfg.train_files)
+            logger.info("fixed unique-row bucket: %d", uniq_bucket)
+        val_bucket = 0
+        if multi_process and cfg.validation_files:
+            val_bucket = cfg.uniq_bucket or probe_uniq_bucket(
+                cfg, cfg.validation_files)
+
+        ckpt = CheckpointState(cfg.model_file)
+        global_step = 0
+        restored = ckpt.restore(
+            template=checkpoint_template(cfg, mesh, host=offload))
+        restored_epoch = 0
+        if restored is not None:
+            check_restored_vocab(cfg, restored)
+            global_step = int(restored["step"])
+            restored_epoch = int(restored["epoch"])
+            logger.info("restored checkpoint at step %d", global_step)
+        restored_step = global_step
+        start_epoch = resume_start_epoch(restored_epoch, cfg.epoch_num)
+        if start_epoch:
+            logger.info("resuming interrupted epoch schedule at epoch %d/%d",
+                        start_epoch, cfg.epoch_num)
+        lk = None
+        if offload:
+            # Offload backend (lookup.py; BASELINE config #5): the table/
+            # accumulator live outside HBM. make_offload_backend picks the
+            # in-jit pinned-host implementation (whole step stays in the
+            # async dispatch stream) where the backend compiles it, else the
+            # numpy fallback with its inherent per-step gradient fetch.
+            from fast_tffm_tpu.lookup import (PinnedHostLookup,
+                                              make_offload_backend,
+                                              make_offload_train_step)
+            lk = make_offload_backend(cfg, cfg.seed, restored=restored)
+            if restored is not None:
+                # The backend adopted the arrays (numpy backend: zero-copy)
+                # or copied them into accelerator-host memory (pinned
+                # backend); keeping these references for the rest of
+                # train() would pin a SECOND full table+accumulator in
+                # local RAM for the whole resumed run — a sustained 2x that
+                # is an OOM at config-#5 scale (the same concern
+                # HostOffloadLookup.load documents for transient copies).
+                restored["table"] = restored["acc"] = None
+            kind = (f"pinned-host in-jit ({lk.mode})"
+                    if isinstance(lk, PinnedHostLookup) else "host-numpy")
+            logger.info("offload lookup [%s]: table [%d, %d] outside HBM "
+                        "(%.2f GB + accumulator)", kind, lk.rows, lk.dim,
+                        lk.rows * lk.dim * 4 / 2**30)
+            offload_step = make_offload_train_step(spec, lk,
+                                                   cfg.learning_rate)
+            table = acc = None
+
+            def step_fn(_t, _a, labels, weights, uniq_ids, local_idx, vals,
+                        fields=None):
+                loss, scores = offload_step(labels, weights, uniq_ids,
+                                            local_idx, vals, fields)
+                return None, None, loss, scores
+        elif mesh is not None:
+            if restored is not None:
+                # The sharded template already placed these row-sharded on
+                # this mesh in the runtime [ckpt_rows, D] layout — use as-is.
+                table, acc = restored["table"], restored["acc"]
+            else:
+                table, acc = init_sharded_state(cfg, mesh, cfg.seed)
+            step_fn = make_sharded_train_step(spec, mesh)
+        else:
+            if restored is not None:
+                table = restored["table"][:cfg.num_rows]
+                acc = restored["acc"][:cfg.num_rows]
+                # The slices above are NEW device buffers; drop the full
+                # [ckpt_rows, D] restored arrays so they free once the
+                # slice completes — holding them for the whole run is a
+                # sustained ~2x HBM cost that only bites on resume.
+                restored["table"] = restored["acc"] = None
+            else:
+                table = init_table(cfg, cfg.seed)
+                acc = init_accumulator(cfg)
+            step_fn = make_train_step(spec)
+
+        # Preemption handling (SURVEY §5 "Failure detection": the reference
+        # only recovers via restart+restore; we additionally save on the way
+        # down). SIGTERM/SIGINT sets a flag the loop drains at the next step
+        # boundary — in multi-process mode the flag rides the lockstep
+        # allgather so every process saves/exits together even when only one
+        # received the signal.
+        preempted: list = []
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(
+                    sig, lambda s, f: preempted.append(s))
+            except ValueError:  # not the main thread (e.g. under a test)
+                pass
+
+        run_start_step = global_step  # profile window counts THIS run's steps
+        # (a resumed job would otherwise skip past the window silently)
+
+        def profile_tick(step_done: int) -> None:
+            nonlocal profiling
+            if not cfg.profile_dir or jax.process_index() != 0:
+                return
+            step_done -= run_start_step
+            if (not profiling and step_done >= cfg.profile_start_step
+                    and step_done < cfg.profile_start_step
+                    + cfg.profile_num_steps):
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling = True
+            elif profiling and step_done >= (cfg.profile_start_step
+                                             + cfg.profile_num_steps):
+                if table is not None:
+                    jax.block_until_ready(table)
+                jax.profiler.stop_trace()
+                profiling = False
+                logger.info("profiler trace written to %s", cfg.profile_dir)
+
+        timer = StepTimer()
+        loss = None
+        loss_val = float("nan")
+        stopping = False
+        last_val = None  # (auc, n) of the most recent validation pass
+
+
+        # TensorBoard scalars (save_summaries_steps; utils/summaries.py).
+        # Chief-only, and flushed ONLY at epoch barriers: values buffer as
+        # device scalars so the cadence adds zero mid-stream fetches.
+        if cfg.save_summaries_steps and jax.process_index() == 0:
+            from fast_tffm_tpu.utils.summaries import make_summaries
+            summaries = make_summaries(cfg)
+            if summaries is not None:
+                logger.info("writing TensorBoard summaries every %d steps "
+                            "to %s", cfg.save_summaries_steps,
+                            summaries.logdir)
+
+        # Adaptive loss logging. float(loss) is a synchronous device->host
+        # fetch; on direct-attached devices it costs microseconds, but over
+        # a proxied/tunnelled device link ANY mid-stream fetch stalls the
+        # async dispatch pipeline catastrophically (measured here: ONE
+        # scalar fetch in a hot stream costs seconds, 528k -> 50k
+        # examples/sec even at a 1/25-step cadence; copy_to_host_async is
+        # just as bad). So the first log step measures the fetch once: if
+        # it is cheap, logging stays live (the normal-hardware behavior);
+        # if not, loss values are buffered ON DEVICE (scalars) and flushed
+        # at epoch boundaries — a natural barrier — with correct per-step
+        # attribution.
+        # Probe the link BEFORE the hot loop, with an empty dispatch queue:
+        # a mid-stream probe on a slow link costs seconds (it drains the
+        # queue through the slow path — measured ~10 s at step 61 of a
+        # criteo-shaped run) where this costs one clean round-trip.
+        def _probe_link() -> str:
+            import time as _time
+            if cfg.log_steps <= 0:
+                return "deferred"  # mode never consulted without log lines
+            probe = jax.device_put(np.float32(0.0))
+            jax.block_until_ready(probe)
+            float(probe)  # throwaway: lazy transfer-path init stays untimed
+            cost = float("inf")
+            for _ in range(3):  # min of 3: jitter must not misclassify
+                # fmlint: disable=R003 -- this IS the link probe's
+                # deliberate timer, before the hot loop starts
+                t0 = _time.perf_counter()
+                # fmlint: disable=R001 -- this IS the link probe: one
+                # deliberate timed scalar fetch, before the hot loop starts
+                float(probe)
+                # fmlint: disable=R003 -- closes the probe sample
+                cost = min(cost, _time.perf_counter() - t0)
+            if cost < LIVE_FETCH_BUDGET_S:
+                # Log the decision either way: a user wondering why loss
+                # lines are (or aren't) live gets the probe's answer.
+                logger.info("scalar fetch costs %.3f ms on this device link; "
+                            "loss log lines stay live", cost * 1e3)
+                return "live"
+            logger.info(
+                "scalar fetch costs %.0f ms on this device link; deferring "
+                "loss log lines to epoch boundaries to keep the dispatch "
+                "pipeline hot", cost * 1e3)
+            return "deferred"
+
+        log_mode = _probe_link()
+        log_buffer: list = []    # deferred: (step, epoch, loss_arr, eps)
+
+        def log_line(s, ep, val, eps):
+            nonlocal loss_val
+            loss_val = val
+            logger.info("step %d epoch %d loss %.6f examples/sec %.0f",
+                        s, ep, val, eps)
+
+        def log_tick(s, ep, loss_arr, eps):
+            if log_mode == "deferred":
+                log_buffer.append((s, ep, loss_arr, eps))
+                # Bound the buffer: log_steps=1 on a months-long epoch must
+                # not retain unbounded device scalars; one rare mid-epoch
+                # sync is the lesser evil.
+                if len(log_buffer) >= LOG_BUFFER_MAX:
+                    flush_log()
+                return
+            log_line(s, ep, float(loss_arr), eps)
+
+        def flush_log():
+            if not log_buffer:
+                return
+            # bulk_fetch stacks the same-shaped scalars into ONE transfer:
+            # deferred mode is only ever active on a slow device link,
+            # where a per-element list fetch costs ~200 ms EACH
+            # (utils/fetch.py) — a full 1024-entry buffer would stall for
+            # minutes.
+            bulk_fetch([(arr, (s, ep, eps))
+                        for s, ep, arr, eps in log_buffer],
+                       lambda v, m: log_line(m[0], m[1], float(v), m[2]))
+            log_buffer.clear()
+        # Handlers stay installed (absorbing re-signals) until the finally
+        # below — i.e. until the final checkpoint/export is safely on disk,
+        # the window a second SIGTERM is most likely to arrive in. The
+        # finally also covers exceptions, so a failed in-process train()
+        # can't leave the surviving process (pytest, REPL, server) with
+        # SIGTERM/SIGINT swallowed into a dead flag list.
         completed_epochs = start_epoch
         last_periodic_save = (None, None)  # (step, epoch) of the latest
         for epoch in range(start_epoch, cfg.epoch_num):
@@ -455,6 +482,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 stats=epoch_stats, raw_ids=raw_mode),
                 depth=cfg.prefetch_depth,
                 gil_bound=gil_bound_iteration(cfg, cfg.weight_files))
+            # fmlint: disable=R003 -- anchors the per-epoch
+            # step-seconds window (always-on aggregate)
             t_step_prev = time.perf_counter()
             while True:
                 # Consumer-side stall: time blocked INSIDE next() only —
@@ -464,9 +493,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 # host-bound signal and misdiagnose a device-bound run
                 # (the producer-side build cost is timed separately in
                 # pipeline.batch_iterator on the worker thread).
+                # fmlint: disable=R003 -- feeds the train/
+                # input_wait_seconds counter (always-on aggregate)
                 t_in = time.perf_counter() if tel is not None else 0.0
                 batch = next(it, None)
                 if tel is not None:
+                    # fmlint: disable=R003 -- closes the input-wait sample
                     tel.count("train/input_wait_seconds",
                               time.perf_counter() - t_in)
                 if multi_process:
@@ -504,16 +536,24 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 h2d_bytes = (batch_payload_bytes(args)
                              if tel is not None else 0)
                 if multi_process:
-                    args = global_batch(mesh, len(batch.uniq_ids), **args)
+                    # span (obs/trace): the explicit H2D dispatch — the
+                    # global-array assembly ships every shard's bytes.
+                    with span("train/h2d", bytes=h2d_bytes):
+                        args = global_batch(mesh, len(batch.uniq_ids),
+                                            **args)
                 elif mesh is not None:
-                    args = shard_batch(mesh, **args)
+                    with span("train/h2d", bytes=h2d_bytes):
+                        args = shard_batch(mesh, **args)
                 # trace_span only while a profiler window is open: a
                 # per-step TraceAnnotation costs ~14x throughput on this
-                # platform when nothing is tracing.
-                span = (trace_span("train_step") if profiling
-                        else contextlib.nullcontext())
-                with span:
-                    table, acc, loss, _ = step_fn(table, acc, **args)
+                # platform when nothing is tracing. (Distinct from the
+                # obs/trace JSONL span around it: that one is a no-op
+                # unless the run enabled trace_spans.)
+                prof_ann = (trace_span("train_step") if profiling
+                            else contextlib.nullcontext())
+                with span("train/step", step=global_step + 1):
+                    with prof_ann:
+                        table, acc, loss, _ = step_fn(table, acc, **args)
                 global_step += 1
                 last_val = None  # table advanced; any cached AUC is stale
                 n_global = batch.num_real * (jax.process_count()
@@ -524,10 +564,17 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     # dispatch-loop time, never a device sync. Reset per
                     # epoch so validation/pause gaps stay out of the
                     # histogram (they have their own counters).
+                    # fmlint: disable=R003 -- feeds the train/
+                    # step_seconds histogram (always-on aggregate; the
+                    # train/step span is the timeline view)
                     now = time.perf_counter()
                     tel.train_step(now - t_step_prev, n_global,
                                    h2d_bytes)
                     t_step_prev = now
+                    # Watchdog progress beat: one tuple assignment
+                    # (obs/health.py) — the stall detector's only
+                    # hot-path cost.
+                    tel.heartbeat(global_step)
                 profile_tick(global_step)
                 log_due = (cfg.log_steps
                            and global_step % cfg.log_steps == 0)
@@ -554,6 +601,9 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                             timer.total_examples_per_sec)
                     tel.maybe_flush(global_step)  # file I/O only
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
+                    # fmlint: disable=R003 -- feeds the train/
+                    # checkpoint_pause_seconds counter (the
+                    # checkpoint/save span is the timeline view)
                     t_ck = time.perf_counter()
                     state = (lk.state() if offload
                              else ckpt_state(cfg, table, acc))
@@ -567,6 +617,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                               wait=offload, epoch=completed_epochs)
                     last_periodic_save = (global_step, completed_epochs)
                     if tel is not None:
+                        # fmlint: disable=R003 -- closes the pause sample
                         dt_ck = time.perf_counter() - t_ck
                         tel.count("train/checkpoint_pause_seconds",
                                   dt_ck)
@@ -606,20 +657,23 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     int(tot[:, 1].sum()), logger,
                     max_uniq=int(tot[:, 2].max()))
             if cfg.validation_files and not stopping:
+                # fmlint: disable=R003 -- feeds the train/
+                # validation_seconds counter (the train/validation span
+                # is the timeline view)
                 t_val = time.perf_counter()
                 vmb = cfg.validation_max_batches or None
-                if multi_process:
-                    auc, n = evaluate_distributed(
-                        cfg, table, cfg.validation_files, mesh,
-                        shard_index, num_shards, uniq_bucket=val_bucket,
-                        max_batches=vmb,
-                        weight_files=cfg.validation_weight_files)
-                else:
-                    auc, n = evaluate(cfg, table, cfg.validation_files,
-                                      mesh=mesh, backend=lk,
-                                      max_batches=vmb,
-                                      weight_files=(
-                                          cfg.validation_weight_files))
+                with span("train/validation", epoch=epoch):
+                    if multi_process:
+                        auc, n = evaluate_distributed(
+                            cfg, table, cfg.validation_files, mesh,
+                            shard_index, num_shards,
+                            uniq_bucket=val_bucket, max_batches=vmb,
+                            weight_files=cfg.validation_weight_files)
+                    else:
+                        auc, n = evaluate(
+                            cfg, table, cfg.validation_files,
+                            mesh=mesh, backend=lk, max_batches=vmb,
+                            weight_files=cfg.validation_weight_files)
                 last_val = (auc, n)
                 if jax.process_index() == 0:
                     logger.info(
@@ -628,6 +682,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 if summaries is not None:
                     summaries.add("validation/auc", global_step, auc)
                 if tel is not None:
+                    # fmlint: disable=R003 -- closes the pause sample
                     tel.count("train/validation_seconds",
                               time.perf_counter() - t_val)
                     tel.set("validation/auc", auc)
@@ -636,9 +691,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     tel.add_scalar("validation/auc", global_step,
                                    float(auc))
             if summaries is not None:  # epoch barrier: bulk-fetch + write
+                # fmlint: disable=R003 -- feeds the train/
+                # summary_pause_seconds counter (always-on aggregate)
                 t_sum = time.perf_counter()
                 summaries.flush()
                 if tel is not None:
+                    # fmlint: disable=R003 -- closes the pause sample
                     tel.count("train/summary_pause_seconds",
                               time.perf_counter() - t_sum)
             if tel is not None:
@@ -692,6 +750,17 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 export_npz(lk.table if offload else table,
                            cfg.model_file + ".npz",
                            vocabulary_size=cfg.vocabulary_size)
+    except BaseException as e:
+        # Crash forensics: the stream's last substantive event carries
+        # the traceback and the recent-event ring, written before the
+        # finally closes the sink (so run_end still terminates the
+        # stream). Never let forensics mask the real error.
+        if tel is not None:
+            try:
+                tel.record_crash(e, global_step)
+            except Exception:
+                logger.exception("crash event emission failed")
+        raise
     finally:
         try:
             # Sink lifecycle on error paths: a crash mid-epoch must not

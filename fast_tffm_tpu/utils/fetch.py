@@ -21,6 +21,13 @@ import numpy as np
 # B=8192).
 FETCH_CHUNK_BATCHES = 256
 
+# close() gives the worker this long to drain before abandoning it: a
+# worker wedged inside a hung device_get (the very stall scenario the
+# error path exists for) must not turn teardown into a silent hang
+# that masks the propagating exception. An abandoned worker is a
+# daemon thread — leaked, but the process stays live and honest.
+CLOSE_DRAIN_TIMEOUT_S = 10.0
+
 
 def bulk_fetch(pairs, consume) -> None:
     """One-shot bulk device->host fetch: ``pairs`` of (value, meta) are
@@ -67,6 +74,7 @@ class ChunkedFetcher:
         self._queue = None
         self._worker = None
         self._err: List[BaseException] = []
+        self._abandon = None  # per-worker Event; set by close()
 
     @property
     def pending_depth(self) -> int:
@@ -100,23 +108,33 @@ class ChunkedFetcher:
             import queue
             import threading
             self._queue = queue.Queue(maxsize=1)
-            self._worker = threading.Thread(target=self._worker_loop,
-                                            daemon=True)
+            self._abandon = threading.Event()
+            # The worker captures ITS queue/error-list/abandon-flag as
+            # arguments: an abandoned worker (close() timed out on a
+            # wedged fetch) that later unwedges must only ever touch
+            # its own orphaned state — never a reused fetcher's fresh
+            # queue or errors.
+            self._worker = threading.Thread(
+                target=self._worker_loop,
+                args=(self._queue, self._err, self._abandon),
+                name="fetcher", daemon=True)
             self._worker.start()
         self._queue.put(batch)  # blocks while the previous chunk fetches
 
-    def _worker_loop(self) -> None:
+    def _worker_loop(self, q, err, abandon) -> None:
         while True:
-            batch = self._queue.get()
+            batch = q.get()
             try:
                 if batch is None:
                     return
-                if not self._err:  # after an error, drain without work
+                if not err and not abandon.is_set():
+                    # after an error (or an abandon-path close), drain
+                    # without work
                     self._fetch_and_consume(batch)
             except BaseException as e:  # noqa: BLE001 - re-raised to caller
-                self._err.append(e)
+                err.append(e)
             finally:
-                self._queue.task_done()
+                q.task_done()
 
     def flush(self) -> None:
         """Fetch + consume everything added so far; with overlap, also
@@ -134,7 +152,61 @@ class ChunkedFetcher:
             self._err.clear()
             raise e
 
+    def close(self) -> None:
+        """Abandon-path teardown, for ``finally`` blocks (ADVICE round
+        5): without it, an exception mid-sweep leaves the overlap
+        worker parked on ``queue.get`` forever and up to one queued
+        chunk of device arrays pinned in device memory for the life of
+        the process. Drops pending work, drains + joins the worker, and
+        swallows worker errors — an exception is usually already
+        propagating, and masking it with a secondary fetch error would
+        hide the real failure. Idempotent; a no-op after a clean
+        ``flush()``; the fetcher remains reusable."""
+        self._pending.clear()
+        if self._worker is not None:
+            import queue
+            import time
+            self._abandon.set()
+            try:
+                # Bounded drain: normally at most one queued chunk
+                # precedes the sentinel and the worker drops it fast
+                # once abandoned; a worker wedged in a hung fetch never
+                # frees the slot, so give up at the deadline rather
+                # than hang the error path.
+                deadline = time.monotonic() + CLOSE_DRAIN_TIMEOUT_S
+                sent = False
+                while time.monotonic() < deadline:
+                    try:
+                        self._queue.put(None, timeout=0.1)
+                        sent = True
+                        break
+                    except queue.Full:
+                        continue
+                if sent:
+                    self._worker.join(
+                        timeout=max(0.0, deadline - time.monotonic())
+                        + 1.0)
+                if self._worker.is_alive():
+                    # Abandoned (still wedged): orphan its error list
+                    # too — its captured abandon flag stays set, so if
+                    # it ever unwedges it drains its own queue and
+                    # exits without touching this fetcher again.
+                    self._err = []
+            finally:
+                self._worker = None
+                self._queue = None
+                self._abandon = None
+        self._err.clear()
+
     def _fetch_and_consume(self, pending) -> None:
+        # span (obs/trace; no-op unless the run traces): every bulk
+        # D2H — predict/evaluate chunks AND barrier scalar drains —
+        # shows up on the timeline, on the thread that paid for it.
+        from fast_tffm_tpu.obs.trace import span
+        with span("fetch/bulk", n=len(pending)):
+            self._fetch_and_consume_inner(pending)
+
+    def _fetch_and_consume_inner(self, pending) -> None:
         arrs = [a for a, _ in pending]
         # device_get on a LIST transfers per-array — N link round-trips.
         # On a proxied device link that multiplies the sweep cost by the

@@ -73,6 +73,19 @@ def main(argv=None) -> int:
     if metrics_override:
         import dataclasses
         cfg = dataclasses.replace(cfg, metrics_file=metrics_override)
+    # Same one-off convention for the timeline/health layer: turn on
+    # span tracing (FM_TRACE_SPANS=1) or the stall watchdog
+    # (FM_WATCHDOG_STALL_SECONDS=120) for a single run without editing
+    # the config. Both need a metrics stream to write into.
+    spans_override = os.environ.get("FM_TRACE_SPANS", "")
+    if spans_override.strip().lower() in ("1", "true", "yes", "on"):
+        import dataclasses
+        cfg = dataclasses.replace(cfg, trace_spans=True)
+    stall_override = os.environ.get("FM_WATCHDOG_STALL_SECONDS")
+    if stall_override:
+        import dataclasses
+        cfg = dataclasses.replace(
+            cfg, watchdog_stall_seconds=float(stall_override))
 
     job_name = task_index = None
     if rest:

@@ -1,0 +1,52 @@
+"""Capability probes for the installed orbax (ISSUE 3 satellite).
+
+The container pins whatever orbax it pins; several checkpoint features
+this repo exercises moved across orbax versions. Rather than skip by
+version number (fragile: features land and regress independently of
+versions), each probe asks the LIBRARY ITSELF whether the capability
+exists — by signature inspection where the API surface answers, by a
+tiny behavioral save/restore probe where only behavior does. Tests
+that need a capability `skipif` on the probe, so on a capable install
+they run (and a real regression fails them), and on this install the
+skip reason names exactly what is missing.
+"""
+
+import functools
+import inspect
+
+
+def orbax_supports_partial_restore() -> bool:
+    """PyTreeRestore(partial_restore=True) — required by
+    CheckpointState.restore_partial (the table-without-accumulator
+    restore the offload predict path uses)."""
+    import orbax.checkpoint as ocp
+    return ("partial_restore"
+            in inspect.signature(ocp.args.PyTreeRestore).parameters)
+
+
+@functools.lru_cache(maxsize=1)
+def orbax_enforces_template_shapes() -> bool:
+    """Whether StandardRestore REJECTS a template whose array shapes
+    disagree with the checkpoint. Older installs silently restore the
+    SAVED shape (warning about sharding-from-file), so the repo's
+    actionable shape-mismatch error can never trigger. Behavioral
+    probe: no API surface answers this."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    import orbax.checkpoint as ocp
+    with tempfile.TemporaryDirectory() as d:
+        mngr = ocp.CheckpointManager(d)
+        try:
+            mngr.save(0, args=ocp.args.StandardSave(
+                {"a": np.zeros((4, 2), np.float32)}))
+            mngr.wait_until_finished()
+            try:
+                mngr.restore(0, args=ocp.args.StandardRestore(
+                    {"a": jax.ShapeDtypeStruct((4, 3), np.float32)}))
+            except Exception:
+                return True
+            return False
+        finally:
+            mngr.close()

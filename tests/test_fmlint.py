@@ -149,3 +149,42 @@ def test_cli_main(tmp_path, capsys):
     ok = tmp_path / "clean.py"
     ok.write_text("x = 1\n")
     assert main([str(ok)]) == 0
+
+
+def test_r003_flags_perf_counter_in_loop(tmp_path):
+    """ISSUE 3 satellite: hot-loop timing should go through the
+    no-op-when-inactive obs.trace.span(), not hand-rolled
+    perf_counter pairs."""
+    path = _hot_file(tmp_path, """\
+        import time
+        def run(it):
+            for x in it:
+                t0 = time.perf_counter()
+                do(x)
+                dt = time.perf_counter() - t0
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R003", "R003"]
+    assert [f.line for f in found] == [4, 6]
+
+
+def test_r003_allows_perf_counter_outside_loops(tmp_path):
+    path = _hot_file(tmp_path, """\
+        import time
+        def stamp():
+            return time.perf_counter()
+    """)
+    assert run_file(path) == []
+
+
+def test_r003_flags_bare_name_and_respects_pragma(tmp_path):
+    path = _hot_file(tmp_path, """\
+        from time import perf_counter
+        def run(it):
+            for x in it:
+                # fmlint: disable=R003 -- feeds an always-on histogram
+                t0 = perf_counter()
+                t1 = perf_counter()
+    """)
+    found = run_file(path)
+    assert [(f.rule, f.line) for f in found] == [("R003", 6)]

@@ -18,7 +18,16 @@ from fast_tffm_tpu.lookup import (HostOffloadLookup, PinnedHostLookup,
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
                                      init_accumulator, init_table,
                                      make_grad_fn, make_train_step)
+from tests.orbax_caps import orbax_supports_partial_restore
 from tests.test_e2e import make_dataset
+
+# ISSUE 3 triage: these paths need PyTreeRestore(partial_restore=True)
+# (CheckpointState.restore_partial — the table-without-accumulator
+# restore). On an orbax without it the feature cannot work at all, so
+# skipping is honest; a capable install still runs them.
+requires_partial_restore = pytest.mark.skipif(
+    not orbax_supports_partial_restore(),
+    reason="installed orbax PyTreeRestore lacks partial_restore")
 
 
 def _cfg(tmp_path, **kw):
@@ -98,6 +107,7 @@ def host_cfg_files(tmp_path, rng):
     return tmp_path, cfg_path, labels
 
 
+@requires_partial_restore
 def test_host_lookup_e2e_cli(host_cfg_files):
     """Full CLI train -> checkpoint -> predict with lookup = host, and
     the scores match a device-backend predict from the same checkpoint."""
@@ -139,6 +149,7 @@ def test_host_lookup_resume(host_cfg_files):
     assert not np.array_equal(t1, lk2.table)
 
 
+@requires_partial_restore
 def test_from_checkpoint_table_only(host_cfg_files):
     """with_acc=False (predict) restores just the table leaf: the
     accumulator — half the state at offload scale — never materializes."""
@@ -152,6 +163,7 @@ def test_from_checkpoint_table_only(host_cfg_files):
     assert lean.step == full.step
 
 
+@requires_partial_restore
 def test_predict_with_caller_table_stays_host_side(host_cfg_files):
     """predict(cfg, table=...) under lookup=host must wrap the provided
     host table in the backend (for_table), not ship it to a device."""

@@ -9,6 +9,7 @@ import pytest
 from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.parser import parse_lines
 from fast_tffm_tpu.data.pipeline import make_device_batch
+from tests.orbax_caps import orbax_enforces_template_shapes
 
 
 def test_example_longer_than_ladder_gets_pow2_bucket():
@@ -116,6 +117,11 @@ def test_ignored_reference_knobs_warn(tmp_path):
     assert dataclasses.replace(cfg, shuffle_threads=0).prefetch_depth == 2
 
 
+@pytest.mark.skipif(
+    not orbax_enforces_template_shapes(),
+    reason="installed orbax silently restores shape-mismatched "
+           "templates (sharding-from-file path), so the actionable "
+           "error can never trigger (ISSUE 3 triage)")
 def test_checkpoint_shape_mismatch_is_actionable(tmp_path):
     # A checkpoint written under one config restored under another must
     # fail with a message naming the shapes and the fix, not orbax's

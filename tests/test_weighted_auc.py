@@ -5,6 +5,8 @@ loss and metric disagreed about what an example is worth). The
 reference has no AUC at all (SURVEY.md §5 "Metrics"), so this is a
 within-framework consistency contract, not upstream parity."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -195,3 +197,59 @@ validation_weight_files = vw.txt
                  weight_files=("w.txt",))
     # globbed lists defer to the iteration-time post-expansion check
     FmConfig(train_files=("shard-*.txt",), weight_files=("w.txt",))
+
+
+def test_weight_sidecar_glob_pairing_per_pattern(tmp_path):
+    """ISSUE 3 satellite (ADVICE round 5): sidecar globs expand PER
+    PATTERN PAIR — per-pattern count mismatches fail loudly instead of
+    positionally zipping weights onto the wrong files, and matched
+    pairs line up by construction."""
+    from fast_tffm_tpu.data.pipeline import expand_paired_files
+    for i in range(3):
+        (tmp_path / f"day{i}.txt").write_text(f"1 {i}:1\n")
+        (tmp_path / f"day{i}.w").write_text("2.0\n")
+    (tmp_path / "extra.txt").write_text("0 9:1\n")
+    (tmp_path / "extra.w").write_text("3.0\n")
+
+    # parallel naming schemes pair correctly pattern by pattern
+    files, sidecars = expand_paired_files(
+        [str(tmp_path / "day*.txt"), str(tmp_path / "extra.txt")],
+        [str(tmp_path / "day*.w"), str(tmp_path / "extra.w")])
+    assert [os.path.basename(f) for f in files] == [
+        "day0.txt", "day1.txt", "day2.txt", "extra.txt"]
+    assert [os.path.basename(s) for s in sidecars] == [
+        "day0.w", "day1.w", "day2.w", "extra.w"]
+
+    # per-pattern count mismatch: 3 data files vs 1 sidecar — the old
+    # flat zip would only have caught a TOTAL-length mismatch
+    (tmp_path / "day1.w").unlink()
+    (tmp_path / "day2.w").unlink()
+    with pytest.raises(ValueError, match="mismatched counts"):
+        expand_paired_files([str(tmp_path / "day*.txt")],
+                            [str(tmp_path / "day*.w")])
+
+    # pattern-LIST length mismatch is its own loud failure
+    with pytest.raises(ValueError, match="pattern per data pattern"):
+        expand_paired_files(["a.txt", "b.txt"], ["w.txt"])
+
+    # and the check fires on the real iteration path too: one data
+    # pattern (3 hits) against two sidecar patterns whose TOTAL could
+    # never pair pattern-wise — the old flat zip would have compared
+    # totals only. batch_iterator is lazy, so force it.
+    (tmp_path / "w_a.w").write_text("1.0\n")
+    (tmp_path / "w_b.w").write_text("1.0\n")
+    cfg = FmConfig(vocabulary_size=100, batch_size=4, shuffle=False)
+    with pytest.raises(ValueError, match="pattern per data pattern"):
+        list(batch_iterator(
+            cfg, [str(tmp_path / "day*.txt")],
+            weight_files=[str(tmp_path / "w_a.w"),
+                          str(tmp_path / "w_b.w")],
+            epochs=1))
+
+
+def test_weight_files_without_train_files_raises():
+    """ISSUE 3 satellite: mirror of the validation-side pairing check —
+    weight_files with empty train_files is a config mistake, caught at
+    validation time."""
+    with pytest.raises(ValueError, match="weight_files given without"):
+        FmConfig(weight_files=("w.txt",))

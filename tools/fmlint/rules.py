@@ -89,4 +89,34 @@ def r002_bare_print(path: str, tree: ast.AST) -> List[Finding]:
     return found
 
 
-RULES = (r001_scalar_fetch, r002_bare_print)
+def r003_raw_perf_counter(path: str, tree: ast.AST) -> List[Finding]:
+    """time.perf_counter() inside a loop body in hot modules: the
+    hand-rolled version of span timing. obs/trace.span() is a no-op
+    when no run traces (one module-global read), emits into the same
+    JSONL stream fmtrace replays, and can't be forgotten half-paired.
+    Raw timing that feeds an always-on aggregate (a telemetry counter/
+    histogram) is legitimate — justify it with a pragma."""
+    if not is_hot_module(path):
+        return []
+    in_loop: set = set()
+    for loop in _loops(tree):
+        for node in ast.walk(loop):
+            in_loop.add(id(node))
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) not in in_loop:
+            continue
+        f = node.func
+        named = (isinstance(f, ast.Attribute) and f.attr == "perf_counter"
+                 ) or (isinstance(f, ast.Name) and f.id == "perf_counter")
+        if named:
+            found.append(Finding(
+                "R003", path, node.lineno,
+                "raw perf_counter() in a hot-loop body; use the "
+                "no-op-when-inactive obs.trace.span() for timeline "
+                "timing, or justify an aggregate-feeding timer with "
+                "a pragma"))
+    return found
+
+
+RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter)

@@ -20,22 +20,13 @@ merged summary + attribution as one JSON object for scripting.
 from __future__ import annotations
 
 import argparse
-import glob as globlib
 import json
 import sys
 import time
-from typing import List
 
-from fast_tffm_tpu.obs.attribution import attribution, render, summarize
-from fast_tffm_tpu.obs.sink import read_events
-
-
-def _expand(paths: List[str]) -> List[str]:
-    out: List[str] = []
-    for p in paths:
-        hits = sorted(globlib.glob(p))
-        out.extend(hits if hits else [p])
-    return out
+from fast_tffm_tpu.obs.attribution import (attribution, health_verdict,
+                                           render, summarize)
+from tools import expand_stream_args
 
 
 def _tail(path: str, out=sys.stdout) -> None:  # pragma: no cover - loop
@@ -98,22 +89,20 @@ def main(argv=None) -> int:
     ap.add_argument("--tail", action="store_true",
                     help="follow the (first) file, print events live")
     args = ap.parse_args(argv)
-    files = _expand(args.files)
+    # Shared glob + fail-loudly-on-unreadable policy (tools/__init__).
+    files = expand_stream_args(args.files)
     if args.tail:
         try:
             _tail(files[0])
         except KeyboardInterrupt:
             return 0
         return 0
-    # Fail loudly on unreadable inputs (the repo's loud-failure
-    # mandate); read_events itself tolerates only torn final lines.
-    for f in files:
-        next(iter(read_events(f)), None)
     summary = summarize(files)
     if args.json:
         out = dict(summary)
         out.pop("scalars", None)
         out["attribution"] = attribution(summary)
+        out["health"] = health_verdict(summary)
         print(json.dumps(out, default=str))
         return 0
     print(render(summary))

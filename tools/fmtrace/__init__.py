@@ -1,0 +1,172 @@
+"""fmtrace — export a run's metrics JSONL stream to Perfetto.
+
+    python -m tools.fmtrace <metrics.jsonl> [more shards...] [-o out.json]
+
+Converts the obs/ telemetry stream (spans, gauges, scalars, health and
+crash events) into Chrome trace-event JSON loadable in ui.perfetto.dev
+(or chrome://tracing). Pass a multi-process run's chief file plus its
+``.p<i>`` worker shards together (a glob works): each process becomes
+its own Perfetto process track (pid = process index), and each
+span-emitting thread (main loop, prefetch, fetcher, watchdog) its own
+row within it — so a cluster's timeline reads as one aligned picture,
+wall-clock synced across workers.
+
+Mapping:
+
+- ``span`` events -> complete ("X") slices: ``ts`` is the span's wall
+  start, ``dur`` its measured duration, extra span fields ride in
+  ``args``.
+- ``metrics`` events -> counter ("C") tracks for every numeric gauge
+  (examples/sec and friends), sampled at the flush cadence.
+- ``scalar`` events (loss, validation AUC) -> counter tracks too.
+  Their timestamp is EMISSION time (the epoch barrier that fetched
+  them), not the step's wall time — the step number is in ``args``.
+- ``health`` / ``crash`` / ``run_start`` / ``run_end`` -> instant
+  ("i") markers, so a stall or crash is visible in place on the
+  timeline.
+
+Pure functions over parsed events (no jax import) — shared by the CLI
+and tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from fast_tffm_tpu.obs.sink import read_events
+from tools import expand_stream_args
+
+
+def _us(t: float) -> float:
+    """Seconds -> the microseconds the trace-event format speaks."""
+    return t * 1e6
+
+
+class _TidMap:
+    """Stable small ints per (pid, thread-name), plus the metadata
+    events that name the rows in the UI. tid 0 is reserved for the
+    per-process counter tracks."""
+
+    def __init__(self):
+        self._map: Dict[tuple, int] = {}
+        self.meta: List[Dict[str, Any]] = []
+
+    def tid(self, pid: int, name: Optional[str]) -> int:
+        name = name or "main"
+        key = (pid, name)
+        t = self._map.get(key)
+        if t is None:
+            t = self._map[key] = len(
+                [k for k in self._map if k[0] == pid]) + 1
+            self.meta.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": t,
+                "args": {"name": name}})
+        return t
+
+
+def to_trace_events(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    """The traceEvents list for one run's files (chief + shards)."""
+    out: List[Dict[str, Any]] = []
+    tids = _TidMap()
+    named_pids = set()
+    for path in paths:
+        pid = 0  # until a run_start announces the real process index
+        for rec in read_events(path):
+            ev = rec.get("event")
+            t = rec.get("t", 0.0)
+            if ev == "run_start":
+                meta = rec.get("meta") or {}
+                pid = int(meta.get("process_index") or 0)
+                if pid not in named_pids:
+                    named_pids.add(pid)
+                    out.append({
+                        "ph": "M", "name": "process_name", "pid": pid,
+                        "tid": 0,
+                        "args": {"name": f"worker {pid} "
+                                         f"({meta.get('kind', '?')})"}})
+                out.append(_instant("run_start", t, pid))
+            elif ev == "span":
+                extra = {k: v for k, v in rec.items()
+                         if k not in ("event", "t", "name", "ts", "dur",
+                                      "tid")}
+                out.append({
+                    "ph": "X", "cat": "span", "name": rec.get("name", "?"),
+                    "pid": pid, "tid": tids.tid(pid, rec.get("tid")),
+                    "ts": _us(rec.get("ts", t)),
+                    "dur": _us(rec.get("dur", 0.0)),
+                    "args": extra,
+                })
+            elif ev == "metrics":
+                for name, v in (rec.get("gauges") or {}).items():
+                    if isinstance(v, (int, float)) and math.isfinite(v):
+                        out.append({
+                            "ph": "C", "name": name, "pid": pid,
+                            "tid": 0, "ts": _us(t),
+                            "args": {"value": v}})
+            elif ev == "scalar":
+                val = rec.get("value")
+                if isinstance(val, (int, float)) and math.isfinite(val):
+                    # args holds ONLY the value: every args key of a
+                    # "C" event is its own plotted series, so a step
+                    # number here would stack a huge second series
+                    # that flattens the one being shown.
+                    out.append({
+                        "ph": "C", "name": rec.get("name", "scalar"),
+                        "pid": pid, "tid": 0, "ts": _us(t),
+                        "args": {"value": val}})
+            elif ev == "health":
+                out.append(_instant(
+                    f"health: {rec.get('status', '?')}", t, pid,
+                    args={k: v for k, v in rec.items()
+                          if k not in ("event", "t")}))
+            elif ev == "crash":
+                out.append(_instant(
+                    "crash: " + str(rec.get("error", "?"))[:120], t, pid,
+                    args={"step": rec.get("step")}))
+            elif ev == "run_end":
+                out.append(_instant("run_end", t, pid))
+    out.extend(tids.meta)
+    # Stable paint order: metadata first, then by timestamp.
+    out.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return out
+
+
+def _instant(name: str, t: float, pid: int,
+             args: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    rec = {"ph": "i", "s": "p", "name": name, "pid": pid, "tid": 0,
+           "ts": _us(t)}
+    if args:
+        rec["args"] = args
+    return rec
+
+
+def convert(paths: Sequence[str], out_path: str) -> int:
+    """Write the Perfetto JSON for ``paths``; returns the event count."""
+    events = to_trace_events(paths)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return len(events)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fmtrace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("files", nargs="+",
+                    help="metrics JSONL file(s); pass the chief file "
+                         "plus its .p<i> worker shards (globs ok)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: <first file>.trace.json)")
+    args = ap.parse_args(argv)
+    # Shared glob + fail-loudly-on-unreadable policy (tools/__init__).
+    files = expand_stream_args(args.files)
+    out_path = args.out or files[0] + ".trace.json"
+    n = convert(files, out_path)
+    print(f"wrote {n} trace events from {len(files)} file(s) to "
+          f"{out_path}\nopen in https://ui.perfetto.dev (Open trace "
+          "file)", file=sys.stderr)
+    return 0
