@@ -7,6 +7,7 @@
 #   make test       run the test suite
 #   make bench      run the benchmark (one JSON line)
 #   make lint       fmlint over the hot-loop modules
+#   make chaos      fault-injection soak scenarios on CPU (fmchaos)
 #   make clean
 
 CXX ?= g++
@@ -29,7 +30,10 @@ bench: $(SO)
 lint:
 	python -m tools.fmlint
 
+chaos: $(SO)
+	JAX_PLATFORMS=cpu python -m tools.fmchaos
+
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench lint clean
+.PHONY: all test bench lint chaos clean

@@ -17,14 +17,32 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from fast_tffm_tpu.obs.trace import span
+from fast_tffm_tpu.utils.retry import RetryPolicy, retry_io
 
 
 class CheckpointState:
     """Manages checkpoints under ``<model_file>.ckpt/`` (orbax needs a
-    directory; the reference's ``model_file`` is a path prefix)."""
+    directory; the reference's ``model_file`` is a path prefix).
 
-    def __init__(self, model_file: str, max_to_keep: int = 3):
+    ``retry`` (utils/retry.py; train/predict thread the config's
+    ``io_retries``/``io_backoff_seconds`` here) wraps the orbax
+    RESTORE entry points in the transient-IO retry loop — restore is
+    a pure read, so re-driving it is always safe. SAVE is deliberately
+    NOT retried, in either phase: a transient failure after orbax has
+    created the step directory would make a blind re-dispatch collide
+    as StepAlreadyExistsError — which save()'s handler treats as the
+    benign same-step case — silently recording a half-written
+    checkpoint as done (strictly worse than failing loudly); and an
+    async save's background-write failure surfaces at a later wait,
+    outside any wrapper, where the snapshot needed to re-drive it is
+    gone. Only genuinely retryable errors (OSError/TimeoutError minus
+    the missing-path family) retry on restore; orbax's semantic errors
+    (shape mismatches) propagate on the first raise."""
+
+    def __init__(self, model_file: str, max_to_keep: int = 3,
+                 retry: Optional[RetryPolicy] = None):
         self.directory = os.path.abspath(model_file) + ".ckpt"
+        self._retry = retry or RetryPolicy(retries=0)
         os.makedirs(self.directory, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self.directory,
@@ -66,6 +84,10 @@ class CheckpointState:
                        "epoch": int(epoch),
                        "vocab": int(vocabulary_size)}
             try:
+                # No retry here (class docstring): re-dispatching a
+                # save whose first attempt half-created the step dir
+                # would surface as the benign StepAlreadyExists path
+                # below and silently skip the save.
                 self._mngr.save(step, args=ocp.args.StandardSave(payload),
                                 force=force)
                 # A FRESH save at this step carries authoritative metadata:
@@ -191,9 +213,11 @@ class CheckpointState:
             try:
                 restored, err = _restore_tolerating_legacy_epoch(
                     template,
-                    lambda t: reader.restore(
-                        s, args=ocp.args.PyTreeRestore(
-                            item=t, partial_restore=True)))
+                    lambda t: retry_io(
+                        reader.restore, s,
+                        args=ocp.args.PyTreeRestore(
+                            item=t, partial_restore=True),
+                        policy=self._retry, op="checkpoint_restore"))
                 if err is not None:
                     raise err
                 return self._apply_epoch_override(s, restored)
@@ -216,12 +240,16 @@ class CheckpointState:
             if s is None:
                 return None
             if template is None:
-                return self._apply_epoch_override(s,
-                                                  self._mngr.restore(s))
+                return self._apply_epoch_override(
+                    s, retry_io(self._mngr.restore, s,
+                                policy=self._retry,
+                                op="checkpoint_restore"))
             restored, err = _restore_tolerating_legacy_epoch(
                 template,
-                lambda t: self._mngr.restore(
-                    s, args=ocp.args.StandardRestore(t)))
+                lambda t: retry_io(
+                    self._mngr.restore, s,
+                    args=ocp.args.StandardRestore(t),
+                    policy=self._retry, op="checkpoint_restore"))
             if err is not None:
                 self._raise_restore_error(s, err)
             return self._apply_epoch_override(s, restored)

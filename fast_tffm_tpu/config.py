@@ -139,6 +139,29 @@ class FmConfig:
     # all-thread stacks to <metrics_file>.stacks when no train/predict
     # step lands for this many seconds. 0 (default) = off.
     watchdog_stall_seconds: float = 0.0
+    # Data-plane fault tolerance (README "Fault tolerance").
+    # What a malformed input line does to the run (data/badlines.py):
+    # "error" (default) aborts on the first bad line — the historical
+    # behavior; "skip" drops the line, counts it (pipeline/bad_lines)
+    # and emits rate-limited `health: bad_input` events; "quarantine"
+    # additionally appends the raw line + file/lineno to
+    # <metrics_file>.quarantine (<model_file>.quarantine when metrics
+    # are off).
+    bad_line_policy: str = "error"  # "error" | "skip" | "quarantine"
+    # Circuit breaker for skip/quarantine: once bad lines exceed this
+    # fraction of scanned lines (and a small absolute floor, so one
+    # early bad line can't trip a tiny sample), the run aborts naming
+    # the worst file — silent corpus rot must not train a garbage
+    # model.
+    max_bad_fraction: float = 0.01
+    # Transient-IO retry (utils/retry.py): extra attempts after the
+    # first for retryable errors (OSError/TimeoutError minus the
+    # definitely-fatal missing-path family) on pipeline file
+    # opens/reads, weight-sidecar reads, and checkpoint save/restore.
+    # Backoff is io_backoff_seconds * 2^k with seeded jitter; retries
+    # count io/retries in the metrics stream. 0 = fail fast.
+    io_retries: int = 2
+    io_backoff_seconds: float = 0.1
 
     # --- [Predict] ---------------------------------------------------------
     predict_files: Tuple[str, ...] = ()
@@ -233,6 +256,22 @@ class FmConfig:
             raise ValueError(
                 f"watchdog_stall_seconds must be >= 0 (0 = watchdog "
                 f"off), got {self.watchdog_stall_seconds}")
+        if self.bad_line_policy not in ("error", "skip", "quarantine"):
+            raise ValueError(
+                f"unknown bad_line_policy {self.bad_line_policy!r} "
+                "(want error | skip | quarantine)")
+        if not 0.0 <= self.max_bad_fraction <= 1.0:
+            raise ValueError(
+                f"max_bad_fraction must be in [0, 1], got "
+                f"{self.max_bad_fraction}")
+        if self.io_retries < 0:
+            raise ValueError(
+                f"io_retries must be >= 0 (0 = fail fast), got "
+                f"{self.io_retries}")
+        if self.io_backoff_seconds < 0:
+            raise ValueError(
+                f"io_backoff_seconds must be >= 0, got "
+                f"{self.io_backoff_seconds}")
         if self.weight_files and not self.train_files:
             # Mirror of the validation_weight_files check above: a
             # sidecar list with nothing to pair against is always a
@@ -330,6 +369,10 @@ _TRAIN_KEYS = {
     "metrics_flush_steps": int,
     "trace_spans": bool,
     "watchdog_stall_seconds": float,
+    "bad_line_policy": str,
+    "max_bad_fraction": float,
+    "io_retries": int,
+    "io_backoff_seconds": float,
 }
 _PREDICT_KEYS = {
     "predict_files": _split_files,

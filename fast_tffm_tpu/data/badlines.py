@@ -1,0 +1,182 @@
+"""Bad-line policy bookkeeping: skip/quarantine accounting, the
+rate-limited ``health: bad_input`` events, and the ``max_bad_fraction``
+circuit breaker (README "Fault tolerance").
+
+The production corpora fast_tffm served (SURVEY §5) are huge, messy,
+and regenerated daily — a single malformed line must not abort a
+multi-hour run (``bad_line_policy = skip|quarantine``), but silent
+corpus rot must not train a garbage model either, so the breaker
+aborts with the worst offending file named once the bad fraction
+crosses the configured ceiling.
+
+One ``BadLineTracker`` instance follows one run's pipeline (train
+passes a single tracker through every epoch's iterator; evaluate/
+predict auto-create their own), so the fraction, the per-file
+attribution, and the quarantine dedupe all see the whole run:
+
+- every skipped line counts ``pipeline/bad_lines`` in the metrics
+  stream and bumps the per-file tally;
+- ``health: bad_input`` events are rate-limited on a power-of-two
+  schedule (the 1st, 2nd, 4th, 8th, ... bad line emits) — visibility
+  without letting a 1%-corrupt terabyte corpus write millions of
+  events;
+- ``quarantine`` appends one JSON line per offending input line —
+  ``{"file", "lineno", "error", "raw"}`` — to the quarantine sidecar
+  (``<metrics_file>.quarantine``, or ``<model_file>.quarantine`` when
+  metrics are off), deduplicated by (file, lineno) so a multi-epoch
+  run records each bad line once;
+- the breaker trips when ``bad / total > max_bad_fraction`` AND at
+  least ``MIN_BAD_LINES_TO_TRIP`` lines are bad (one early bad line
+  in a small sample must not abort a run the fraction would forgive
+  over the full corpus).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Set, Tuple
+
+# Absolute floor before the fraction breaker may trip: the fraction
+# estimate over fewer bad lines than this is too noisy to abort on.
+MIN_BAD_LINES_TO_TRIP = 8
+
+
+class BadInputError(ValueError):
+    """The max_bad_fraction circuit breaker: too much of the corpus is
+    malformed for skip/quarantine to be safe."""
+
+
+def quarantine_path(cfg) -> str:
+    """Where this process quarantines offending lines: beside the
+    metrics stream when one exists, beside the model file otherwise.
+    BOTH branches carry the per-process shard suffix (the metrics path
+    already has it; the model-file fallback adds its own), so P
+    concurrent writers of a multi-process run never interleave in one
+    file."""
+    from fast_tffm_tpu.obs.telemetry import resolve_metrics_path
+    base = resolve_metrics_path(cfg)
+    if base is None:
+        base = getattr(cfg, "model_file", "./fm_model")
+        import jax
+        p = jax.process_index()
+        if p:
+            base = f"{base}.p{p}"
+    return base + ".quarantine"
+
+
+class BadLineTracker:
+    """Accounting for one run's bad-line policy; see module docstring.
+
+    ``record()`` raises BadInputError when the breaker trips — the
+    pipeline lets it propagate, aborting the run with the worst file
+    named. ``count_ok(n)`` feeds the denominator."""
+
+    def __init__(self, policy: str, max_bad_fraction: float,
+                 quarantine_file: Optional[str] = None):
+        if policy not in ("skip", "quarantine"):
+            raise ValueError(
+                f"BadLineTracker is for tolerant policies, got "
+                f"{policy!r}")
+        self.policy = policy
+        self.max_bad_fraction = float(max_bad_fraction)
+        self.quarantine_file = quarantine_file
+        self.total = 0          # lines scanned (good + bad)
+        self.bad = 0            # lines skipped
+        self.by_file: Dict[str, int] = {}
+        self._next_emit = 1     # power-of-two health-event schedule
+        self._quarantined: Set[Tuple[str, int]] = set()
+        self._q_fh = None
+        # The tracker is run-scoped and fed from prefetch PRODUCER
+        # threads; an abandoned producer (evaluate breaking out at
+        # validation_max_batches) can briefly overlap the next
+        # epoch's, so the counters and the quarantine handle serialize
+        # here rather than losing updates.
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["BadLineTracker"]:
+        """A tracker per the config's policy, or None for ``error``
+        (the zero-cost default path)."""
+        policy = getattr(cfg, "bad_line_policy", "error")
+        if policy == "error":
+            return None
+        return cls(policy, getattr(cfg, "max_bad_fraction", 0.01),
+                   quarantine_file=(quarantine_path(cfg)
+                                    if policy == "quarantine" else None))
+
+    # -- accounting ------------------------------------------------------
+    def count_ok(self, n: int) -> None:
+        with self._lock:
+            self.total += n
+
+    def record(self, path: str, lineno: int, raw: str,
+               error: str) -> None:
+        """One bad line skipped: count, attribute, maybe emit a health
+        event, maybe quarantine, check the breaker (which raises)."""
+        from fast_tffm_tpu.obs.telemetry import active
+        tel = active()
+        with self._lock:
+            self.total += 1
+            self.bad += 1
+            self.by_file[path] = self.by_file.get(path, 0) + 1
+            if tel is not None:
+                tel.count("pipeline/bad_lines")
+                if self.bad >= self._next_emit:
+                    while self._next_emit <= self.bad:
+                        self._next_emit *= 2
+                    tel.sink.emit("health", {
+                        "status": "bad_input",
+                        "policy": self.policy,
+                        "bad_lines": self.bad,
+                        "total_lines": self.total,
+                        "file": path,
+                        "lineno": lineno,
+                        "error": error[:200],
+                    })
+            if (self.quarantine_file is not None
+                    and (path, lineno) not in self._quarantined):
+                self._quarantined.add((path, lineno))
+                if self._q_fh is None:
+                    d = os.path.dirname(os.path.abspath(
+                        self.quarantine_file))
+                    os.makedirs(d, exist_ok=True)
+                    self._q_fh = open(self.quarantine_file, "a",
+                                      encoding="utf-8")
+                self._q_fh.write(json.dumps(
+                    {"file": path, "lineno": lineno, "error": error,
+                     "raw": raw}) + "\n")
+                self._q_fh.flush()  # must survive a later crash
+            self._check_breaker()
+
+    def _check_breaker(self) -> None:
+        # Caller holds the lock (BadInputError propagates out of the
+        # `with`, releasing it).
+        if (self.bad >= MIN_BAD_LINES_TO_TRIP and self.total
+                and self.bad / self.total > self.max_bad_fraction):
+            worst, n_worst = max(self.by_file.items(),
+                                 key=lambda kv: kv[1])
+            raise BadInputError(
+                f"aborting: {self.bad} of {self.total} input lines "
+                f"({self.bad / self.total:.2%}) are malformed, over "
+                f"the max_bad_fraction ceiling "
+                f"({self.max_bad_fraction:.2%}); worst file: {worst} "
+                f"({n_worst} bad lines). The corpus looks corrupt — "
+                "fix the data (see the quarantine file if "
+                "bad_line_policy = quarantine) or raise "
+                "max_bad_fraction if this corruption level is "
+                "expected.")
+
+    def describe(self) -> str:
+        frac = self.bad / self.total if self.total else 0.0
+        return (f"{self.bad} bad line(s) of {self.total} scanned "
+                f"({frac:.3%}) under policy {self.policy}"
+                + (f"; quarantined to {self.quarantine_file}"
+                   if self.quarantine_file else ""))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._q_fh is not None:
+                self._q_fh.close()
+                self._q_fh = None

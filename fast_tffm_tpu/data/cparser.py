@@ -253,6 +253,50 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
                        fields=fields[:z].copy() if field_aware else None)
 
 
+def parse_lines_salvage(lines: Sequence[str], vocabulary_size: int,
+                        hash_feature_id: bool = False,
+                        field_aware: bool = False, field_num: int = 0,
+                        max_features_per_example: int = 0,
+                        keep_empty: bool = False,
+                        bad_lines: Optional[list] = None) -> ParsedBlock:
+    """Tolerant block parse — the per-line failure surface of
+    ``bad_line_policy = skip|quarantine`` over the C++ fast path.
+
+    The C++ block parser is all-or-nothing by design (its threads
+    abort the failing shard; per-line bookkeeping would slow the
+    clean-corpus hot path that is 99.99%+ of production bytes). So
+    tolerance is layered: the block goes through the C++ parser first,
+    and only a FAILING block is retried through the Python parser's
+    per-line tolerant mode, which identifies every bad line (recorded
+    into ``bad_lines`` as ``(index, raw, message)``) and returns the
+    block minus those lines. Clean blocks pay zero extra cost; a block
+    with a bad line pays one Python re-parse of that block only.
+
+    ``keep_empty`` blocks skip the C++ attempt outright (the block
+    parser has no blank-line-preserving mode; pipeline._parse_block
+    makes the same routing choice).
+    """
+    if bad_lines is None:
+        bad_lines = []
+    if not keep_empty:
+        try:
+            return parse_lines_fast(
+                lines, vocabulary_size,
+                hash_feature_id=hash_feature_id,
+                field_aware=field_aware, field_num=field_num,
+                max_features_per_example=max_features_per_example)
+        except (OSError, RuntimeError):
+            pass  # C++ extension unavailable -> Python handles it all
+        except ParseError:
+            pass  # failing block -> tolerant Python retry below
+    from fast_tffm_tpu.data.parser import parse_lines
+    return parse_lines(
+        lines, vocabulary_size, hash_feature_id=hash_feature_id,
+        field_aware=field_aware, field_num=field_num,
+        max_features_per_example=max_features_per_example,
+        keep_empty=keep_empty, bad_lines=bad_lines)
+
+
 class BatchBuilder:
     """Streaming raw-bytes -> padded-batch builder (C++ `fm_bb_*`).
 

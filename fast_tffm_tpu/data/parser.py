@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -88,7 +88,9 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
                 field_aware: bool = False,
                 field_num: int = 0,
                 max_features_per_example: int = 0,
-                keep_empty: bool = False) -> ParsedBlock:
+                keep_empty: bool = False,
+                bad_lines: Optional[List[Tuple[int, str, str]]] = None
+                ) -> ParsedBlock:
     """Parse a block of lines into a CSR batch.
 
     ``max_features_per_example`` > 0 truncates overlong examples (static-
@@ -96,6 +98,16 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
     unless ``keep_empty`` — then they become zero-feature examples with
     label 0, preserving line alignment (predict owes one score per input
     line, SURVEY §3.4).
+
+    ``bad_lines`` (not None) switches to TOLERANT mode — the per-line
+    failure surface of ``bad_line_policy = skip|quarantine``
+    (data/badlines.py): a line that would raise ``ParseError`` is
+    instead recorded as ``(lineno, raw_line, message)`` and produces no
+    example — except under ``keep_empty``, where it becomes a
+    zero-feature example so predict's one-score-per-input-line
+    alignment survives a bad line. The partial example the failing
+    line had accumulated is rolled back, so the CSR block holds only
+    whole, valid examples.
     """
     labels: List[float] = []
     poses: List[int] = [0]
@@ -110,65 +122,26 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
                 labels.append(0.0)
                 poses.append(len(ids))
             continue
+        # Buffer marks for tolerant rollback: a ParseError can fire
+        # mid-line with a label and a prefix of the line's tokens
+        # already appended; the block must hold only whole examples.
+        n_labels, n_ids, n_flds = len(labels), len(ids), len(flds)
         try:
-            label = _strict_float(toks[0])
-        except ValueError:
-            raise ParseError(f"line {lineno}: bad label {toks[0]!r}")
-        labels.append(label)
-        n = 0
-        for tok in toks[1:]:
-            if max_features_per_example and n >= max_features_per_example:
-                break
-            parts = tok.split(":")
-            if field_aware:
-                if len(parts) == 2:
-                    fld_s, fid_s, val_s = parts[0], parts[1], None
-                elif len(parts) == 3:
-                    fld_s, fid_s, val_s = parts
-                else:
-                    raise ParseError(
-                        f"line {lineno}: bad ffm token {tok!r} "
-                        "(want field:fid[:val])")
-                try:
-                    fld = _strict_int(fld_s)
-                except ValueError:
-                    raise ParseError(f"line {lineno}: bad field {fld_s!r}")
-                if not 0 <= fld < field_num:
-                    raise ParseError(
-                        f"line {lineno}: field {fld} out of range "
-                        f"[0, {field_num})")
-                flds.append(fld)
-            else:
-                if len(parts) == 1:
-                    fid_s, val_s = parts[0], None
-                elif len(parts) == 2:
-                    fid_s, val_s = parts
-                else:
-                    raise ParseError(
-                        f"line {lineno}: bad token {tok!r} (want fid[:val])")
-            if hash_feature_id:
-                fid = hash_feature(fid_s, vocabulary_size)
-            else:
-                try:
-                    fid = _strict_int(fid_s)
-                except ValueError:
-                    raise ParseError(
-                        f"line {lineno}: non-integer feature id {fid_s!r} "
-                        "(set hash_feature_id = True for string ids)")
-                if not 0 <= fid < vocabulary_size:
-                    raise ParseError(
-                        f"line {lineno}: feature id {fid} out of range "
-                        f"[0, {vocabulary_size})")
-            if val_s is None:
-                val = 1.0
-            else:
-                try:
-                    val = _strict_float(val_s)
-                except ValueError:
-                    raise ParseError(f"line {lineno}: bad value {val_s!r}")
-            ids.append(fid)
-            vals.append(val)
-            n += 1
+            _parse_one(toks, lineno, labels, ids, vals, flds,
+                       vocabulary_size, hash_feature_id, field_aware,
+                       field_num, max_features_per_example)
+        except ParseError as e:
+            if bad_lines is None:
+                raise
+            del labels[n_labels:], ids[n_ids:], vals[n_ids:]
+            del flds[n_flds:]
+            bad_lines.append((lineno, line, str(e)))
+            if keep_empty:
+                # Predict alignment: the bad line still owes a score —
+                # a zero-feature example scores as the model bias.
+                labels.append(0.0)
+                poses.append(len(ids))
+            continue
         poses.append(len(ids))
 
     return ParsedBlock(
@@ -178,3 +151,72 @@ def parse_lines(lines: Sequence[str], vocabulary_size: int,
         vals=np.asarray(vals, dtype=np.float32),
         fields=np.asarray(flds, dtype=np.int32) if field_aware else None,
     )
+
+
+def _parse_one(toks: List[str], lineno: int, labels, ids, vals, flds,
+               vocabulary_size: int, hash_feature_id: bool,
+               field_aware: bool, field_num: int,
+               max_features_per_example: int) -> None:
+    """Parse one line's tokens, appending onto the CSR buffers (the
+    one per-line implementation both strict and tolerant modes run).
+    Raises ParseError mid-append on a bad token; parse_lines' tolerant
+    mode rolls the partial appends back."""
+    try:
+        label = _strict_float(toks[0])
+    except ValueError:
+        raise ParseError(f"line {lineno}: bad label {toks[0]!r}")
+    labels.append(label)
+    n = 0
+    for tok in toks[1:]:
+        if max_features_per_example and n >= max_features_per_example:
+            break
+        parts = tok.split(":")
+        if field_aware:
+            if len(parts) == 2:
+                fld_s, fid_s, val_s = parts[0], parts[1], None
+            elif len(parts) == 3:
+                fld_s, fid_s, val_s = parts
+            else:
+                raise ParseError(
+                    f"line {lineno}: bad ffm token {tok!r} "
+                    "(want field:fid[:val])")
+            try:
+                fld = _strict_int(fld_s)
+            except ValueError:
+                raise ParseError(f"line {lineno}: bad field {fld_s!r}")
+            if not 0 <= fld < field_num:
+                raise ParseError(
+                    f"line {lineno}: field {fld} out of range "
+                    f"[0, {field_num})")
+            flds.append(fld)
+        else:
+            if len(parts) == 1:
+                fid_s, val_s = parts[0], None
+            elif len(parts) == 2:
+                fid_s, val_s = parts
+            else:
+                raise ParseError(
+                    f"line {lineno}: bad token {tok!r} (want fid[:val])")
+        if hash_feature_id:
+            fid = hash_feature(fid_s, vocabulary_size)
+        else:
+            try:
+                fid = _strict_int(fid_s)
+            except ValueError:
+                raise ParseError(
+                    f"line {lineno}: non-integer feature id {fid_s!r} "
+                    "(set hash_feature_id = True for string ids)")
+            if not 0 <= fid < vocabulary_size:
+                raise ParseError(
+                    f"line {lineno}: feature id {fid} out of range "
+                    f"[0, {vocabulary_size})")
+        if val_s is None:
+            val = 1.0
+        else:
+            try:
+                val = _strict_float(val_s)
+            except ValueError:
+                raise ParseError(f"line {lineno}: bad value {val_s!r}")
+        ids.append(fid)
+        vals.append(val)
+        n += 1

@@ -27,12 +27,17 @@ import functools
 import glob as globlib
 import os
 import random
+import re
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from fast_tffm_tpu.config import FmConfig
-from fast_tffm_tpu.data.parser import WHITESPACE, ParsedBlock
+from fast_tffm_tpu.data.badlines import BadLineTracker
+from fast_tffm_tpu.data.parser import (WHITESPACE, ParsedBlock,
+                                       ParseError)
+from fast_tffm_tpu.utils.retry import (RetryPolicy, open_with_retry,
+                                       retry_io)
 
 
 class UniqOverflow(ValueError):
@@ -338,7 +343,9 @@ def shard_byte_range(path: str, shard_index: int,
 
 
 def _iter_owned_chunks(path: str, start: int, end: int,
-                       chunk_bytes: int = 4 << 20) -> Iterator[bytes]:
+                       chunk_bytes: int = 4 << 20,
+                       retry: Optional[RetryPolicy] = None
+                       ) -> Iterator[bytes]:
     """Yield byte chunks that together contain exactly the lines owned
     by byte range [start, end) of ``path``.
 
@@ -348,13 +355,37 @@ def _iter_owned_chunks(path: str, start: int, end: int,
     on that newline, so every line is owned exactly once); the line
     straddling ``end`` is read to completion. Only the final chunk at
     EOF may lack a trailing newline.
+
+    ``retry`` wraps the open and each chunk read in the transient-IO
+    retry loop (utils/retry.py) — a flaky networked filesystem costs
+    backoff, not the run. Retry is at CHUNK granularity, and every
+    attempt seeks back to the chunk's start offset first: a partial
+    buffered read ADVANCES the underlying position before raising, so
+    a naive in-place retry would silently resume past the lost bytes
+    (truncated/merged lines — wrong training data, the worst failure
+    mode this module exists to prevent).
     """
-    with open(path, "rb") as fh:
+    if retry is None:
+        fh = open(path, "rb")
+    else:
+        fh = open_with_retry(path, "rb", policy=retry, op="data_open")
+
+    def read(n: int) -> bytes:
+        if retry is None:
+            return fh.read(n)
+        pos0 = fh.tell()
+
+        def attempt() -> bytes:
+            fh.seek(pos0)
+            return fh.read(n)
+        return retry_io(attempt, policy=retry, op="data_read")
+
+    with fh:
         pos = start
         if start > 0:
             fh.seek(start - 1)
             while True:  # skip to the byte after the first newline
-                b = fh.read(chunk_bytes)
+                b = read(chunk_bytes)
                 if not b:
                     return  # EOF before any owned line
                 i = b.find(b"\n")
@@ -365,7 +396,7 @@ def _iter_owned_chunks(path: str, start: int, end: int,
         if pos >= end:
             return  # first owned line starts past the range
         while True:
-            b = fh.read(chunk_bytes)
+            b = read(chunk_bytes)
             if not b:
                 return
             if pos + len(b) >= end:
@@ -381,7 +412,9 @@ def _iter_owned_chunks(path: str, start: int, end: int,
             pos += len(b)
 
 
-def _iter_range_lines(path: str, start: int, end: int) -> Iterator[str]:
+def _iter_range_lines(path: str, start: int, end: int,
+                      retry: Optional[RetryPolicy] = None
+                      ) -> Iterator[str]:
     """Decoded lines owned by byte range [start, end) of ``path``
     (ownership rules of _iter_owned_chunks). Splits on newlines BEFORE
     decoding so a multibyte UTF-8 character straddling a chunk boundary
@@ -389,7 +422,7 @@ def _iter_range_lines(path: str, start: int, end: int) -> Iterator[str]:
     shared by _iter_lines and probe_uniq_bucket (the C++ fast path
     consumes raw bytes and never forms lines in Python)."""
     tail = b""
-    for chunk in _iter_owned_chunks(path, start, end):
+    for chunk in _iter_owned_chunks(path, start, end, retry=retry):
         parts = (tail + chunk if tail else chunk).split(b"\n")
         tail = parts.pop()
         for raw in parts:
@@ -448,8 +481,16 @@ def _owned_start_line_index_for(path: str, start: int, _size: int,
 
 def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                 shard_index: int, num_shards: int,
-                keep_empty: bool = False) -> Iterator[Tuple[str, float]]:
-    """Yield (line, weight) pairs for this shard.
+                keep_empty: bool = False,
+                retry: Optional[RetryPolicy] = None
+                ) -> Iterator[Tuple[str, float, Tuple[str, int, int,
+                                                      int]]]:
+    """Yield (line, weight, source) triples for this shard, where
+    ``source = (path, rel_lineno, shard_index, num_shards)`` is the
+    line's provenance: ``rel_lineno`` is 1-based within the shard's
+    owned byte range, resolved to an absolute file line number only on
+    the error path (_resolve_source — the newline scan is lazy, so
+    clean runs never pay it).
 
     Sharding is per-file byte ranges (shard_byte_range): each worker
     PARSES only its ~1/N of the bytes. Weight files are line-parallel to
@@ -469,7 +510,10 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
         for path, wpath in zip(files, weight_files):
             start, end = shard_byte_range(path, shard_index, num_shards)
             n_skip = _owned_start_line_index(path, start)
-            with open(wpath) as wfh:
+            wfh = (open(wpath) if retry is None else
+                   open_with_retry(wpath, policy=retry,
+                                   op="sidecar_open"))
+            with wfh:
                 # Weight files are LINE-PARALLEL sidecars; a missing or
                 # blank weight line means the pairing is broken
                 # (truncated copy, corrupted file) and every example
@@ -482,9 +526,12 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                             f"data file {path}: ended at line {i} while "
                             f"skipping to this shard's start ({n_skip})")
                 lineno = n_skip
-                for line in _iter_range_lines(path, start, end):
+                rel = 0
+                for line in _iter_range_lines(path, start, end,
+                                              retry=retry):
                     wline = wfh.readline()
                     lineno += 1
+                    rel += 1
                     if not wline:
                         raise ValueError(
                             f"weight file {wpath} is shorter than its "
@@ -500,16 +547,71 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                         raise ValueError(
                             f"bad weight {wline.strip()!r} at {wpath} "
                             f"line {lineno}") from None
-                    yield line, w
+                    yield line, w, (path, rel, shard_index, num_shards)
         return
     for path in files:
         start, end = shard_byte_range(path, shard_index, num_shards)
-        for line in _iter_range_lines(path, start, end):
+        rel = 0
+        for line in _iter_range_lines(path, start, end, retry=retry):
+            rel += 1
             # strip() pinned to the libsvm separator set: a line holding
             # only \x1c would read as blank here (skipped) but as a
             # parse-error line on the C++ fast path otherwise.
             if line.strip(WHITESPACE) or keep_empty:
-                yield line, 1.0
+                yield line, 1.0, (path, rel, shard_index, num_shards)
+
+
+# Both parser paths prefix errors "line <block-relative-index>: ...";
+# the pipeline layers the real provenance (file, absolute lineno,
+# shard) on top, so a bad line in a 40-file glob is findable.
+_LINE_MSG = re.compile(r"^line (\d+): (.*)$", re.S)
+
+
+def _source_lineno(src: Tuple[str, int, int, int]) -> Tuple[str, int]:
+    """(path, absolute 1-based file lineno) for a provenance tuple —
+    what the quarantine record carries. The newline scan resolving the
+    shard's starting line is memoized and error/bad-line-path-only;
+    falls back to the shard-relative lineno when the file went
+    unreadable underneath us."""
+    path, rel, si, ns = src
+    try:
+        start, _ = shard_byte_range(path, si, ns)
+        return path, _owned_start_line_index(path, start) + rel
+    except OSError:
+        return path, rel
+
+
+def _resolve_source(src: Tuple[str, int, int, int]) -> str:
+    """Human-findable rendering of a provenance tuple (_source_lineno's
+    absolute lineno, plus the shard byte range when sharded)."""
+    path, rel, si, ns = src
+    _, abs_ln = _source_lineno(src)
+    if ns <= 1:
+        return f"{path} line {abs_ln}"
+    try:
+        start, end = shard_byte_range(path, si, ns)
+    except OSError:
+        return f"{path} line {abs_ln} (of shard {si}/{ns})"
+    return f"{path} line {abs_ln}, shard {si}/{ns} (bytes {start}-{end})"
+
+
+def _strip_line_prefix(msg: str) -> str:
+    m = _LINE_MSG.match(msg)
+    return m.group(2) if m else msg
+
+
+def _attach_block_source(e: ParseError,
+                         provenance: Sequence[Tuple[str, int, int, int]]
+                         ) -> ParseError:
+    """Rewrite a block-relative ParseError ("line 3: bad label ...")
+    with the failing line's file/lineno/shard provenance."""
+    m = _LINE_MSG.match(str(e))
+    if not m:
+        return e
+    i = int(m.group(1))
+    if i >= len(provenance):
+        return e
+    return ParseError(f"{_resolve_source(provenance[i])}: {m.group(2)}")
 
 
 def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
@@ -597,9 +699,17 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
             yield batch
 
     tail = b""
+    fed_lines = 0       # complete lines fed to the builder so far —
+    # mirrors the C++ builder's internal lineno (it counts every fed
+    # line; a spilled line is re-fed but counted once on both sides)
+    file_spans: List[Tuple[int, str, int, int]] = []  # (lines_before,
+    # path, start, end) per file fed — the provenance map builder
+    # "line N" errors resolve against (threaded feeds DEFER errors, so
+    # one can surface while a later file is being fed)
 
     def feed_all(data: bytes) -> Iterator[DeviceBatch]:
-        nonlocal tail
+        nonlocal tail, fed_lines
+        fed_lines += data.count(b"\n")  # complete lines get consumed
         off = 0
         while True:
             full, consumed = bb.feed(data, off)
@@ -613,21 +723,58 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
             yield from drain(emit(*out, spilled=out[0] < B))
         tail = data[off:]  # unconsumed partial line, re-fed next chunk
 
+    retry = RetryPolicy.from_config(cfg)
     file_seed = cfg.seed if seed is None else seed
-    for epoch in range(n_epochs):
-        for path in epoch_file_order(files, shuffle, file_seed, epoch):
-            start, end = shard_byte_range(path, shard_index, num_shards)
-            tail = b""
-            for chunk in _iter_owned_chunks(path, start, end):
-                yield from feed_all(tail + chunk if tail else chunk)
-            if tail:  # final owned line missing its newline
-                yield from feed_all(tail + b"\n")
-        n, labels, uniq, li, vals, fields, max_nnz = bb.finish()
-        if n:  # short final batch of the epoch
-            yield from drain(emit(n, labels, uniq, li, vals, fields,
-                                  max_nnz))
-        while window:
-            yield window.pop(pyrng.randrange(len(window)))
+    try:
+        for epoch in range(n_epochs):
+            for path in epoch_file_order(files, shuffle, file_seed,
+                                         epoch):
+                start, end = shard_byte_range(path, shard_index,
+                                              num_shards)
+                tail = b""
+                file_spans.append((fed_lines, path, start, end))
+                for chunk in _iter_owned_chunks(path, start, end,
+                                                retry=retry):
+                    yield from feed_all(tail + chunk if tail else chunk)
+                if tail:  # final owned line missing its newline
+                    yield from feed_all(tail + b"\n")
+            n, labels, uniq, li, vals, fields, max_nnz = bb.finish()
+            if n:  # short final batch of the epoch
+                yield from drain(emit(n, labels, uniq, li, vals, fields,
+                                      max_nnz))
+            while window:
+                yield window.pop(pyrng.randrange(len(window)))
+    except ParseError as e:
+        raise _attach_stream_source(e, file_spans, num_shards) from None
+
+
+def _attach_stream_source(e: ParseError,
+                          file_spans: Sequence[Tuple[int, str, int,
+                                                     int]],
+                          num_shards: int) -> ParseError:
+    """Rewrite a builder-stream ParseError ("line N: ..." where N
+    counts every line fed to the builder since its creation) with the
+    owning file's path and the absolute file line number. The span map
+    is searched rather than assuming the current file: the threaded
+    builder defers a parse error until batch consumption reaches it,
+    which can be while a LATER file is feeding."""
+    m = _LINE_MSG.match(str(e))
+    if not m or not file_spans:
+        return e
+    n = int(m.group(1))
+    owner = file_spans[0]
+    for span_rec in file_spans:
+        if span_rec[0] < n:
+            owner = span_rec
+        else:
+            break
+    base, path, start, end = owner
+    try:
+        abs_ln = _owned_start_line_index(path, start) + (n - base)
+    except OSError:
+        return ParseError(f"{path}: {e}")
+    note = (f", shard bytes {start}-{end}" if num_shards > 1 else "")
+    return ParseError(f"{path} line {abs_ln}{note}: {m.group(2)}")
 
 
 def _num_uniq(uniq_ids, pad_id: int) -> int:
@@ -655,7 +802,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    fixed_shape: bool = False,
                    uniq_bucket: int = 0,
                    stats: Optional[SpillStats] = None,
-                   raw_ids: bool = False
+                   raw_ids: bool = False,
+                   bad_lines: Optional[BadLineTracker] = None
                    ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files (see _batch_iterator_impl
     for the full contract). This wrapper is the pipeline's telemetry
@@ -674,7 +822,7 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                               keep_empty=keep_empty,
                               fixed_shape=fixed_shape,
                               uniq_bucket=uniq_bucket, stats=stats,
-                              raw_ids=raw_ids)
+                              raw_ids=raw_ids, bad_lines=bad_lines)
     tel = active()
     if tel is None:
         yield from it
@@ -710,7 +858,8 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
                          fixed_shape: bool = False,
                          uniq_bucket: int = 0,
                          stats: Optional[SpillStats] = None,
-                         raw_ids: bool = False
+                         raw_ids: bool = False,
+                         bad_lines: Optional[BadLineTracker] = None
                          ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files.
 
@@ -724,6 +873,16 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
 
     ``raw_ids`` (dedup=device): skip the host unique pass; batches carry
     raw ids in local_idx and uniq_ids=None (models/fm dedups on device).
+
+    ``bad_lines``: the run-scoped BadLineTracker when the caller owns
+    one (train passes a single tracker through every epoch so the
+    bad-fraction breaker and the quarantine dedupe see the whole run);
+    with a tolerant ``cfg.bad_line_policy`` and no caller tracker, one
+    is created per iteration (evaluate/predict). Tolerant policies
+    ride the generic path — the streaming C++ builder stays
+    all-or-nothing (_fast_path_eligible) and per-line failures are
+    reported through the block-level salvage parse
+    (cparser.parse_lines_salvage).
     """
     from fast_tffm_tpu.data.parser import parse_lines
     from fast_tffm_tpu.data.cparser import parse_lines_fast
@@ -779,68 +938,137 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
     # keep_empty needs blank lines to become zero-feature examples; only
     # the Python parser implements that.
     parse = None if keep_empty else parse_lines_fast
+    retry = RetryPolicy.from_config(cfg)
+    tracker = bad_lines
+    own_tracker = False
+    if tracker is None:
+        tracker = BadLineTracker.from_config(cfg)
+        own_tracker = tracker is not None
+
+    def parse_chunk(chunk, precounted: int = 0):
+        """One pending chunk -> (surviving chunk, block, weights).
+
+        Error policy: a ParseError propagates with the failing line's
+        file/lineno/shard provenance attached. Tolerant policies: bad
+        lines are recorded in the tracker (which may raise the
+        max_bad_fraction breaker) and dropped from the chunk — except
+        under keep_empty, where the parser already replaced them with
+        zero-feature examples so predict's line alignment holds.
+
+        ``precounted``: the first this-many chunk items already passed
+        through the tracker on an earlier pass (a UniqOverflow spill
+        requeues its tail at the front of pending) — they must not
+        count or record a second time, or spills would inflate the
+        totals and break the skip-count-equals-injected contract."""
+        lines = [c[0] for c in chunk]
+        if tracker is None:
+            try:
+                block = _parse_block(lines, cfg, parse, keep_empty)
+            except ParseError as e:
+                raise _attach_block_source(
+                    e, [c[2] for c in chunk]) from None
+        else:
+            bads: List[Tuple[int, str, str]] = []
+            block = _salvage_block(lines, cfg, keep_empty, bads)
+            fresh_bads = [b for b in bads if b[0] >= precounted]
+            tracker.count_ok(len(lines) - precounted
+                             - len(fresh_bads))
+            if fresh_bads:
+                for i, raw, msg in fresh_bads:
+                    path, abs_ln = _source_lineno(chunk[i][2])
+                    tracker.record(path, abs_ln, raw,
+                                   _strip_line_prefix(msg))
+            if bads and not keep_empty:
+                badset = {i for i, _, _ in bads}
+                chunk = [c for i, c in enumerate(chunk)
+                         if i not in badset]
+        w = np.array([c[1] for c in chunk], dtype=np.float32)
+        return chunk, block, w
 
     file_seed = cfg.seed if seed is None else seed
-    for epoch in range(n_epochs):
-        pending: List[Tuple[str, float]] = []
-        buf: List[Tuple[str, float]] = []
+    try:
+        for epoch in range(n_epochs):
+            pending: List[Tuple[str, float, tuple]] = []
+            buf: List[Tuple[str, float, tuple]] = []
+            # How many FRONT items of `pending` already passed through
+            # the tracker (spill-requeued tails); see parse_chunk.
+            requeue_counted = [0]
 
-        def flush_batches(done: bool):
-            while len(pending) >= B or (done and pending):
-                chunk = pending[:B]
-                del pending[:B]
-                lines = [c[0] for c in chunk]
-                w = np.array([c[1] for c in chunk], dtype=np.float32)
-                block = _parse_block(lines, cfg, parse, keep_empty)
-                try:
-                    out = make_device_batch(block, cfg, weights=w,
-                                            batch_size=B,
-                                            fixed_shape=fixed_shape,
-                                            uniq_bucket=uniq_bucket,
-                                            raw_ids=raw_ids)
-                    if stats is not None:
-                        stats.count(out.num_real, B, False,
-                                    num_uniq=_batch_num_uniq(out, cfg))
-                    yield out
-                except UniqOverflow:
-                    # Spill: emit the longest example prefix that fits
-                    # the unique budget; the tail reopens the queue.
-                    m = _uniq_prefix_examples(block, uniq_bucket)
-                    if m == 0:
-                        raise ValueError(
-                            "single example exceeds uniq_bucket "
-                            f"{uniq_bucket}; raise it (or set 0 for "
-                            "auto)")
-                    pending[0:0] = chunk[m:]
-                    head = _parse_block([c[0] for c in chunk[:m]], cfg,
-                                        parse, keep_empty)
-                    out = make_device_batch(head, cfg, weights=w[:m],
-                                            batch_size=B,
-                                            fixed_shape=fixed_shape,
-                                            uniq_bucket=uniq_bucket)
-                    if stats is not None:
-                        stats.count(out.num_real, B, True,
-                                    num_uniq=_batch_num_uniq(out, cfg))
-                    yield out
+            def flush_batches(done: bool):
+                while len(pending) >= B or (done and pending):
+                    raw_chunk = pending[:B]
+                    del pending[:B]
+                    k = min(requeue_counted[0], len(raw_chunk))
+                    requeue_counted[0] -= k
+                    chunk, block, w = parse_chunk(raw_chunk,
+                                                  precounted=k)
+                    if tracker is not None and block.batch_size == 0:
+                        continue  # every line of the chunk was bad
+                    try:
+                        out = make_device_batch(block, cfg, weights=w,
+                                                batch_size=B,
+                                                fixed_shape=fixed_shape,
+                                                uniq_bucket=uniq_bucket,
+                                                raw_ids=raw_ids)
+                        if stats is not None:
+                            stats.count(out.num_real, B, False,
+                                        num_uniq=_batch_num_uniq(out,
+                                                                 cfg))
+                        yield out
+                    except UniqOverflow:
+                        # Spill: emit the longest example prefix that
+                        # fits the unique budget; the tail reopens the
+                        # queue.
+                        m = _uniq_prefix_examples(block, uniq_bucket)
+                        if m == 0:
+                            raise ValueError(
+                                "single example exceeds uniq_bucket "
+                                f"{uniq_bucket}; raise it (or set 0 "
+                                "for auto)")
+                        pending[0:0] = chunk[m:]
+                        if tracker is not None:
+                            # The requeued tail is already tracked; it
+                            # must not count/record again next pass.
+                            requeue_counted[0] += len(chunk) - m
+                        # Re-parse of already-validated survivors: no
+                        # tracker (they were counted once above).
+                        head = _parse_block([c[0] for c in chunk[:m]],
+                                            cfg, parse, keep_empty,
+                                            salvage=tracker is not None)
+                        out = make_device_batch(head, cfg,
+                                                weights=w[:m],
+                                                batch_size=B,
+                                                fixed_shape=fixed_shape,
+                                                uniq_bucket=uniq_bucket)
+                        if stats is not None:
+                            stats.count(out.num_real, B, True,
+                                        num_uniq=_batch_num_uniq(out,
+                                                                 cfg))
+                        yield out
 
-        for item in _iter_lines(
-                epoch_file_order(files, do_shuffle and not weight_files,
-                                 file_seed, epoch),
-                weight_files,
-                shard_index, num_shards, keep_empty=keep_empty):
-            if do_shuffle:
-                buf.append(item)
-                if len(buf) >= max(cfg.queue_size, B):
-                    j = rng.randrange(len(buf))
-                    buf[j], buf[-1] = buf[-1], buf[j]
-                    pending.append(buf.pop())
-            else:
-                pending.append(item)
-            yield from flush_batches(False)
-        if do_shuffle and buf:
-            rng.shuffle(buf)
-            pending.extend(buf)
-        yield from flush_batches(True)
+            for item in _iter_lines(
+                    epoch_file_order(files,
+                                     do_shuffle and not weight_files,
+                                     file_seed, epoch),
+                    weight_files,
+                    shard_index, num_shards, keep_empty=keep_empty,
+                    retry=retry):
+                if do_shuffle:
+                    buf.append(item)
+                    if len(buf) >= max(cfg.queue_size, B):
+                        j = rng.randrange(len(buf))
+                        buf[j], buf[-1] = buf[-1], buf[j]
+                        pending.append(buf.pop())
+                else:
+                    pending.append(item)
+                yield from flush_batches(False)
+            if do_shuffle and buf:
+                rng.shuffle(buf)
+                pending.extend(buf)
+            yield from flush_batches(True)
+    finally:
+        if own_tracker:
+            tracker.close()
 
 
 def _uniq_prefix_examples(block: ParsedBlock, uniq_bucket: int) -> int:
@@ -875,18 +1103,26 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
     B = batch_size or cfg.batch_size
     files = expand_files(files)
     top = _uniq_ladder(B, effective_L_cap(cfg))[-1]
+    retry = RetryPolicy.from_config(cfg)
     from fast_tffm_tpu.data.cparser import parse_lines_fast
     parse = parse_lines_fast
+    # Tolerant bad-line policies must not die in the PROBE on a line
+    # the training sweep would skip: the probe's density estimate
+    # simply ignores bad lines (they are recorded/counted later, when
+    # the real iterators scan them).
+    tolerant = getattr(cfg, "bad_line_policy", "error") != "error"
 
     cand = sorted({files[0], files[-1],
                    max(files, key=os.path.getsize)})
     u_max = 0
     got_lines = False
     for path in cand:
-        size = os.path.getsize(path)
+        size = retry_io(os.path.getsize, path, policy=retry,
+                        op="probe_stat")
         for start in sorted({0, size // 3, 2 * size // 3}):
             lines: List[str] = []
-            for line in _iter_range_lines(path, start, size):
+            for line in _iter_range_lines(path, start, size,
+                                          retry=retry):
                 if line.strip(WHITESPACE):
                     lines.append(line)
                 if len(lines) >= B:
@@ -894,7 +1130,14 @@ def probe_uniq_bucket(cfg: FmConfig, files: Sequence[str],
             if not lines:
                 continue
             got_lines = True
-            block = _parse_block(lines[:B], cfg, parse)
+            try:
+                block = _parse_block(lines[:B], cfg, parse,
+                                     salvage=tolerant)
+            except ParseError as e:
+                raise ParseError(f"{path} (uniq-bucket probe near "
+                                 f"byte {start}): "
+                                 f"{_strip_line_prefix(str(e))}"
+                                 ) from None
             u_max = max(u_max, len(np.unique(block.ids)))
     if not got_lines:
         return min(1 << 10, top)
@@ -930,14 +1173,21 @@ def empty_batch(cfg: FmConfig, batch_size: Optional[int] = None,
 def _fast_path_eligible(cfg: FmConfig,
                         weight_files: Sequence[str]) -> bool:
     """The ONE gate for the chunked C++ fast path: no per-line Python
-    handling (weight sidecars pair weights to lines in Python) and a
+    handling (weight sidecars pair weights to lines in Python), a
     hard per-example cap (the builder writes fixed-stride rows;
-    max_features_per_example = 0 means "unlimited" and stays generic).
+    max_features_per_example = 0 means "unlimited" and stays generic),
+    and the strict bad-line policy — the streaming builder is
+    all-or-nothing on a parse error by design (its batch state is not
+    recoverable mid-line), so skip/quarantine tolerance lives on the
+    generic path, whose blocks still parse through the C++ block
+    parser with a per-line Python salvage retry only for a FAILING
+    block (cparser.parse_lines_salvage).
     batch_iterator's path selection and gil_bound_iteration's
     GIL-contention answer must agree, so both call here — a hand-copied
     predicate drifting between them would silently thread a GIL-bound
     iterator (or passthrough a releasing one)."""
-    return not weight_files and cfg.max_features_per_example > 0
+    return (not weight_files and cfg.max_features_per_example > 0
+            and getattr(cfg, "bad_line_policy", "error") == "error")
 
 
 def gil_bound_iteration(cfg: FmConfig, weight_files: Sequence[str] = (),
@@ -956,6 +1206,11 @@ def gil_bound_iteration(cfg: FmConfig, weight_files: Sequence[str] = (),
     if not cparser.available():
         return True
     if weight_files:
+        return True
+    if getattr(cfg, "bad_line_policy", "error") != "error":
+        # Tolerant policies ride the generic path: C++ block parse
+        # (GIL released) but per-line Python iteration holds the GIL —
+        # the weighted path's contention class.
         return True
     return (not _fast_path_eligible(cfg, weight_files)) and keep_empty
 
@@ -1037,10 +1292,32 @@ def prefetch(iterator: Iterator[DeviceBatch], depth: int = 2,
         stop.set()
 
 
+def _salvage_block(lines: Sequence[str], cfg: FmConfig,
+                   keep_empty: bool,
+                   bads: List[Tuple[int, str, str]]) -> ParsedBlock:
+    """The ONE cfg -> parse_lines_salvage plumbing (tolerant block
+    parse; cparser). Every tolerant call site goes through here so a
+    future parser knob can't be threaded into one site and missed in
+    another."""
+    from fast_tffm_tpu.data.cparser import parse_lines_salvage
+    return parse_lines_salvage(
+        lines, cfg.vocabulary_size,
+        hash_feature_id=cfg.hash_feature_id,
+        field_aware=cfg.model_type == "ffm", field_num=cfg.field_num,
+        max_features_per_example=cfg.max_features_per_example,
+        keep_empty=keep_empty, bad_lines=bads)
+
+
 def _parse_block(lines: Sequence[str], cfg: FmConfig, fast_parse,
-                 keep_empty: bool = False) -> ParsedBlock:
+                 keep_empty: bool = False,
+                 salvage: bool = False) -> ParsedBlock:
     from fast_tffm_tpu.data.parser import parse_lines
     field_aware = cfg.model_type == "ffm"
+    if salvage:
+        # Tolerant re-parse (the generic path's spill split re-parses
+        # survivor lines whose bad neighbors were already recorded):
+        # bad lines drop silently instead of raising.
+        return _salvage_block(lines, cfg, keep_empty, [])
     if fast_parse is not None:
         try:
             return fast_parse(

@@ -194,7 +194,9 @@ class HostOffloadLookup:
         from fast_tffm_tpu.checkpoint import CheckpointState
         from fast_tffm_tpu.train import (check_restored_vocab,
                                          checkpoint_template)
-        ckpt = CheckpointState(cfg.model_file)
+        from fast_tffm_tpu.utils.retry import RetryPolicy
+        ckpt = CheckpointState(cfg.model_file,
+                               retry=RetryPolicy.from_config(cfg))
         template = checkpoint_template(cfg, host=True)
         if with_acc:
             restored = ckpt.restore(template=template)

@@ -160,6 +160,10 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
         "dedup_hit_rate": dedup_hit_rate(c),
         "padding_waste_fraction": padding_waste(c),
         "parse_errors": c.get("pipeline/parse_errors", 0),
+        # Fault-tolerance accounting (README "Fault tolerance"): lines
+        # skipped under bad_line_policy, and transient-IO retries paid.
+        "bad_lines": c.get("pipeline/bad_lines", 0),
+        "io_retries": c.get("io/retries", 0),
     }
 
     # Predict-path stats (a predict stream has no train loop at all;
@@ -239,10 +243,13 @@ def _bench_verdict(ceil: Dict[str, float]) -> str:
 
 def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
     """The run-health verdict line for one merged summary (obs/health):
-    ``{"verdict": "OK" | "STALLED" | "NONFINITE" | "CRASHED",
-    "detail": ...}``. Read purely from explicit stream events —
-    severity order CRASHED > NONFINITE > STALLED, because a crash ends
-    the run while a survived stall merely delayed it. A stream that
+    ``{"verdict": "OK" | "PREEMPTED" | "STALLED" | "NONFINITE" |
+    "CRASHED", "detail": ...}``. Read purely from explicit stream
+    events — severity order CRASHED > NONFINITE > PREEMPTED > STALLED,
+    because a crash ends the run while a survived stall merely delayed
+    it, and a preemption (train's SIGTERM/SIGINT save-and-exit path
+    emits ``health: preempted``) is a CLEAN exit that must not read as
+    a crash — the run saved, and a restart resumes it. A stream that
     never wrote its run_end gets flagged in the detail either way (a
     hard-killed run writes no crash event; a live run hasn't finished —
     the reader knows which one it is holding)."""
@@ -252,6 +259,7 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
     recoveries = [h for h in health if h.get("status") == "recovered"]
     nonfin = [h for h in health
               if str(h.get("status", "")).startswith("nonfinite")]
+    preempts = [h for h in health if h.get("status") == "preempted"]
     unclosed = (summary.get("run_starts", 0)
                 > summary.get("run_ends", 0))
     notes = []
@@ -273,6 +281,14 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                 "detail": "; ".join(
                     [f"non-finite {', '.join(names)} over steps "
                      f"{lo}..{hi}"] + notes)}
+    if preempts:
+        last = preempts[-1]
+        return {"verdict": "PREEMPTED",
+                "detail": "; ".join(
+                    [f"preemption signalled at step "
+                     f"{last.get('step', '?')} (epoch "
+                     f"{last.get('epoch', '?')}); the run saved and "
+                     "exited cleanly — restart to resume"] + notes)}
     if stalls:
         worst = max(float(h.get("stalled_seconds") or 0) for h in stalls)
         rec = (f", recovered x{len(recoveries)}" if recoveries
@@ -350,6 +366,8 @@ def render(summary: Dict[str, Any]) -> str:
         ("dedup hit rate", att["dedup_hit_rate"]),
         ("padding-waste fraction", att["padding_waste_fraction"]),
         ("parse errors", att["parse_errors"]),
+        ("bad lines skipped", att["bad_lines"]),
+        ("io retries", att["io_retries"]),
     ]
     if att["predict_examples"]:
         rows += [

@@ -123,7 +123,9 @@ class Watchdog:
                          f"====\n")
                 fh.flush()
                 faulthandler.dump_traceback(file=fh, all_threads=True)
-        except Exception:
+        except Exception:  # fmlint: disable=R004 -- a broken stack
+            # dump (unwritable sidecar) must not kill stall DETECTION;
+            # the health event already reached the sink
             pass
 
     # -- thread lifecycle ------------------------------------------------
@@ -135,8 +137,11 @@ class Watchdog:
                 while not self._stop.wait(interval):
                     try:
                         self.check()
-                    except Exception:
-                        pass  # the watchdog must outlive a bad check
+                    except Exception:  # fmlint: disable=R004 -- the
+                        # watchdog daemon must outlive a bad check();
+                        # dying here would silently disarm stall
+                        # detection for the rest of the run
+                        pass
             self._thread = threading.Thread(target=loop, name="watchdog",
                                             daemon=True)
             self._thread.start()
@@ -159,7 +164,9 @@ class Watchdog:
         try:
             self.beat()
             self.check()
-        except Exception:
+        except Exception:  # fmlint: disable=R004 -- best-effort final
+            # recovered event on an already-stopping run; the sink may
+            # legitimately be mid-close here
             pass
 
 

@@ -202,7 +202,9 @@ def _json_default(o: Any):
         if callable(f):
             try:
                 return f()
-            except Exception:
+            except Exception:  # fmlint: disable=R004 -- probing an
+                # .item() coercion; a failure falls through to the
+                # tolist/str fallbacks below, nothing is swallowed
                 pass
     if hasattr(o, "tolist"):
         return o.tolist()

@@ -43,7 +43,9 @@ def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
     logical [num_rows, D] table on the default device."""
     import jax.numpy as jnp
     from fast_tffm_tpu.train import checkpoint_template
-    ckpt = CheckpointState(cfg.model_file)
+    from fast_tffm_tpu.utils.retry import RetryPolicy
+    ckpt = CheckpointState(cfg.model_file,
+                           retry=RetryPolicy.from_config(cfg))
     restored = ckpt.restore(template=checkpoint_template(cfg, mesh))
     ckpt.close()
     if restored is None:

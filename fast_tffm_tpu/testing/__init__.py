@@ -1,0 +1,4 @@
+"""Test-support subpackage: deterministic fault injection for the data
+plane (faults.py). Shipped inside the package (not under tests/) so
+the ``tools/fmchaos`` CLI and external soak harnesses can drive the
+same injectors the test suite pins."""

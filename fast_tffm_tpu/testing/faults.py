@@ -1,0 +1,157 @@
+"""Deterministic, seed-driven fault injection for the data plane.
+
+The recovery paths this repo grew (bad-line policy, IO retry/backoff,
+preemption save/resume — README "Fault tolerance") are exactly the
+code that never runs on a healthy dev box, so they rot unless faults
+are injectable on demand and REPRODUCIBLY: every injector here is
+driven by an explicit seed (or an exact count/step), never wall-clock
+randomness, so a failing chaos scenario replays bit-for-bit.
+
+Injectors (all restore global state on exit):
+
+- ``corrupt_corpus``      — write a corrupted copy of a clean libsvm
+  file with a seeded fraction of lines mangled; returns the exact
+  0-based line indices, so tests pin skip/quarantine counts to the
+  injected truth.
+- ``flaky_open``          — context manager: the first N ``open()``
+  calls whose path matches a substring raise a transient ``OSError``
+  (retryable class), exercising utils/retry.py end to end.
+- ``preempt_after_steps`` — context manager: raises SIGTERM/SIGINT
+  in-process after the Nth train step (hooked on ``StepTimer.tick``,
+  the once-per-step bookkeeping call), so mid-epoch preemption lands
+  at a deterministic step — no timers, no flakes.
+- ``truncate_checkpoint`` — torn-write simulator: truncates one
+  seeded-chosen array file inside the latest checkpoint step
+  directory, for restore-error-path tests.
+
+No jax import at module level: the injectors patch pure-Python seams.
+"""
+
+from __future__ import annotations
+
+import builtins
+import contextlib
+import errno
+import os
+import random
+import signal
+from typing import Iterator, List, Optional
+
+# Corruption shapes that are malformed in EVERY parse mode (plain and
+# hash_feature_id, FM and FFM): a non-float label, and a non-float
+# feature value. (A corrupt feature ID would be legal under hashing.)
+_CORRUPTIONS = (
+    lambda line: "##bad_label## " + line.split(None, 1)[-1],
+    lambda line: line.rstrip() + " 0:##bad_value##",
+)
+
+
+def corrupt_corpus(src: str, dst: str, fraction: float = 0.005,
+                   seed: int = 0) -> List[int]:
+    """Copy ``src`` to ``dst`` with ``max(1, round(n * fraction))``
+    lines corrupted, picked and mangled by a ``seed``-driven RNG.
+    Returns the sorted 0-based indices of the corrupted lines — the
+    ground truth a skip/quarantine accounting test pins against."""
+    with open(src, "r", encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    rng = random.Random(f"corrupt/{seed}")
+    n_bad = max(1, int(round(len(lines) * fraction)))
+    idxs = sorted(rng.sample(range(len(lines)), n_bad))
+    for k, i in enumerate(idxs):
+        lines[i] = _CORRUPTIONS[k % len(_CORRUPTIONS)](lines[i])
+    with open(dst, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return idxs
+
+
+@contextlib.contextmanager
+def flaky_open(n_failures: int, match: str = "",
+               use_errno: int = errno.EIO) -> Iterator[dict]:
+    """Make the first ``n_failures`` ``open()`` calls on paths
+    containing ``match`` raise a RETRYABLE OSError (default EIO — the
+    classic transient networked-FS failure). ``match`` scopes the
+    injection so unrelated opens (logs, metrics sink, checkpoints)
+    pass through. Yields a state dict; ``state["failures"]`` counts
+    injected failures (assert it afterwards to prove the fault
+    actually fired)."""
+    state = {"remaining": int(n_failures), "failures": 0}
+    real_open = builtins.open
+
+    def injected(file, *args, **kwargs):
+        if state["remaining"] > 0:
+            try:
+                name = os.fspath(file)
+            except TypeError:
+                name = ""
+            if not match or match in str(name):
+                state["remaining"] -= 1
+                state["failures"] += 1
+                raise OSError(
+                    use_errno,
+                    f"injected transient open failure "
+                    f"#{state['failures']}", str(name))
+        return real_open(file, *args, **kwargs)
+
+    builtins.open = injected
+    try:
+        yield state
+    finally:
+        builtins.open = real_open
+
+
+@contextlib.contextmanager
+def preempt_after_steps(n: int,
+                        sig: int = signal.SIGTERM) -> Iterator[dict]:
+    """Deliver ``sig`` to THIS process synchronously after the ``n``-th
+    train step, by wrapping ``StepTimer.tick`` (the loop's
+    once-per-step bookkeeping). ``signal.raise_signal`` on the main
+    thread runs train()'s installed handler immediately, so the loop
+    drains the preemption flag at the very next step boundary — the
+    deterministic "mid-epoch SIGTERM scheduler". Yields a state dict
+    (``state["fired"]``)."""
+    from fast_tffm_tpu.utils.timing import StepTimer
+    state = {"steps": 0, "fired": False}
+    real_tick = StepTimer.tick
+
+    def tick(self, n_examples):
+        real_tick(self, n_examples)
+        state["steps"] += 1
+        if state["steps"] >= n and not state["fired"]:
+            state["fired"] = True
+            signal.raise_signal(sig)
+
+    StepTimer.tick = tick
+    try:
+        yield state
+    finally:
+        StepTimer.tick = real_tick
+
+
+def truncate_checkpoint(model_file: str, seed: int = 0,
+                        keep_bytes: int = 8) -> Optional[str]:
+    """Simulate a torn checkpoint write: pick (seeded) one of the
+    largest files under the LATEST step directory of
+    ``<model_file>.ckpt/`` and truncate it to ``keep_bytes``. Returns
+    the truncated path, or None when no step directory exists."""
+    directory = os.path.abspath(model_file) + ".ckpt"
+    if not os.path.isdir(directory):
+        return None
+    steps = [d for d in os.listdir(directory) if d.isdigit()]
+    if not steps:
+        return None
+    step_dir = os.path.join(directory, max(steps, key=int))
+    candidates = []
+    for root, _dirs, names in os.walk(step_dir):
+        for name in names:
+            p = os.path.join(root, name)
+            candidates.append((os.path.getsize(p), p))
+    if not candidates:
+        return None
+    candidates.sort(reverse=True)
+    # Among the largest quartile (the array payloads — truncating a
+    # tiny metadata json is a different, easier failure), pick one.
+    top = candidates[:max(1, len(candidates) // 4)]
+    _, victim = random.Random(f"trunc/{seed}").choice(top)
+    with open(victim, "r+b") as fh:
+        fh.truncate(keep_bytes)
+    return victim

@@ -23,10 +23,12 @@ import numpy as np
 
 from fast_tffm_tpu.checkpoint import CheckpointState, export_npz
 from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.badlines import BadLineTracker
 from fast_tffm_tpu.data.pipeline import (SPILL_WARN_FRACTION, SpillStats,
                                          batch_iterator,
                                          gil_bound_iteration, prefetch,
                                          uniq_bucket_top)
+from fast_tffm_tpu.utils.retry import RetryPolicy
 from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
@@ -56,13 +58,16 @@ LOG_BUFFER_MAX = 1024
 def evaluate(cfg: FmConfig, table: jax.Array, files,
              max_batches: Optional[int] = None,
              mesh=None, backend=None,
-             weight_files=()) -> Tuple[float, int]:
+             weight_files=(), bad_lines=None) -> Tuple[float, int]:
     """Streamed AUC over ``files``; returns (auc, n_examples). Pass the
     training mesh to score a row-sharded table in place, or a lookup
     ``backend`` (lookup.HostOffloadLookup) to score a host-offloaded
     table (``table`` is then unused). ``weight_files`` (sidecars
     parallel to ``files``) weight each example's AUC contribution the
-    same way training weights its loss."""
+    same way training weights its loss. ``bad_lines``: the caller's
+    run-scoped BadLineTracker — train() shares its tracker so
+    per-epoch validation sweeps don't quarantine the same bad line
+    once per epoch through fresh dedupe sets."""
     spec = ModelSpec.from_config(cfg)
     score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
     raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
@@ -83,7 +88,8 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     try:
         for batch in prefetch(batch_iterator(cfg, files, training=False,
                                              weight_files=weight_files,
-                                             epochs=1, raw_ids=raw),
+                                             epochs=1, raw_ids=raw,
+                                             bad_lines=bad_lines),
                               depth=cfg.prefetch_depth,
                               gil_bound=gil_bound_iteration(
                                   cfg, weight_files)):
@@ -111,7 +117,8 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                          shard_index: int, num_shards: int,
                          uniq_bucket: int = 0,
                          max_batches: Optional[int] = None,
-                         weight_files=()) -> Tuple[float, int]:
+                         weight_files=(),
+                         bad_lines=None) -> Tuple[float, int]:
     """Multi-process sharded AUC: every process scores its own input
     shard through the mesh score fn in lockstep (the shared
     lockstep_score_batches protocol), then the per-process binned-AUC
@@ -135,7 +142,8 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
     it = batch_iterator(cfg, files, training=False, epochs=1,
                         weight_files=weight_files,
                         shard_index=shard_index, num_shards=num_shards,
-                        fixed_shape=True, uniq_bucket=ub)
+                        fixed_shape=True, uniq_bucket=ub,
+                        bad_lines=bad_lines)
     for batch, local in lockstep_score_batches(cfg, it, mesh, score_fn,
                                                table, ub,
                                                max_batches=max_batches):
@@ -240,6 +248,10 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     profiling = False
     prev_handlers = {}
     global_step = 0
+    # One run-scoped tracker (None under bad_line_policy = error): the
+    # max_bad_fraction breaker and the quarantine dedupe must see the
+    # WHOLE run, not one epoch's iterator (data/badlines.py).
+    bad_tracker = BadLineTracker.from_config(cfg)
 
     def flush_log():  # rebound once the deferred log buffer exists
         pass
@@ -262,7 +274,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             val_bucket = cfg.uniq_bucket or probe_uniq_bucket(
                 cfg, cfg.validation_files)
 
-        ckpt = CheckpointState(cfg.model_file)
+        ckpt = CheckpointState(cfg.model_file,
+                               retry=RetryPolicy.from_config(cfg))
         global_step = 0
         restored = ckpt.restore(
             template=checkpoint_template(cfg, mesh, host=offload))
@@ -479,7 +492,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 weight_files=cfg.weight_files, shard_index=shard_index,
                 num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
                 fixed_shape=multi_process, uniq_bucket=uniq_bucket,
-                stats=epoch_stats, raw_ids=raw_mode),
+                stats=epoch_stats, raw_ids=raw_mode,
+                bad_lines=bad_tracker),
                 depth=cfg.prefetch_depth,
                 gil_bound=gil_bound_iteration(cfg, cfg.weight_files))
             # fmlint: disable=R003 -- anchors the per-epoch
@@ -516,6 +530,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         stopping = True
                         logger.info(
                             "preemption signalled; saving and exiting")
+                        if tel is not None:
+                            # Distinct health event: fmstat must report
+                            # a clean preemption exit as PREEMPTED, not
+                            # conflate it with a crash (obs/attribution
+                            # health_verdict).
+                            tel.sink.emit("health", {
+                                "status": "preempted",
+                                "step": global_step, "epoch": epoch})
                         break
                     if bool(flags[..., 0].all()):
                         break
@@ -527,6 +549,15 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         stopping = True
                         logger.info(
                             "preemption signalled; saving and exiting")
+                        if tel is not None:
+                            # fmlint: disable=R001 -- preempted holds
+                            # host signal numbers from the handler,
+                            # never device arrays
+                            sigs = [int(s) for s in preempted]
+                            tel.sink.emit("health", {
+                                "status": "preempted",
+                                "step": global_step, "epoch": epoch,
+                                "signals": sigs})
                         break
                     if batch is None:
                         break
@@ -625,6 +656,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                         t_step_prev += dt_ck  # keep the pause out of
                         # the next step's step_seconds sample
             flush_log()  # deferred loss lines land at the epoch barrier
+            if bad_tracker is not None and bad_tracker.bad:
+                # Cumulative run-level view: the breaker and quarantine
+                # are run-scoped, so the log line is too.
+                logger.info("bad-line policy through epoch %d: %s",
+                            epoch, bad_tracker.describe())
             if epoch_stats.spilled_batches or (multi_process
                                                and epoch_stats.batches):
                 # Spill visibility (fixed-U mode): a probe-missed dense
@@ -668,12 +704,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                             cfg, table, cfg.validation_files, mesh,
                             shard_index, num_shards,
                             uniq_bucket=val_bucket, max_batches=vmb,
-                            weight_files=cfg.validation_weight_files)
+                            weight_files=cfg.validation_weight_files,
+                            bad_lines=bad_tracker)
                     else:
                         auc, n = evaluate(
                             cfg, table, cfg.validation_files,
                             mesh=mesh, backend=lk, max_batches=vmb,
-                            weight_files=cfg.validation_weight_files)
+                            weight_files=cfg.validation_weight_files,
+                            bad_lines=bad_tracker)
                 last_val = (auc, n)
                 if jax.process_index() == 0:
                     logger.info(
@@ -734,7 +772,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                   rewrite_stale_metadata=stale)
         if multi_process:
             _chief_finalize(cfg, table, logger, mesh, shard_index,
-                            num_shards, last_val, val_bucket)
+                            num_shards, last_val, val_bucket,
+                            bad_tracker)
         else:
             # Same size gate on EVERY dense-export path: a single-host
             # mesh whose aggregate row-sharded table exceeds host RAM
@@ -784,6 +823,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     tel.close(global_step)
                 except Exception:
                     logger.exception("metrics sink close failed")
+            if bad_tracker is not None:
+                try:
+                    bad_tracker.close()
+                except Exception:
+                    logger.exception("quarantine file close failed")
             pop_active(tel_prev)
             if profiling:
                 # Window ran past the end of training — or the loop
@@ -872,7 +916,8 @@ def adapt_uniq_bucket(cfg: FmConfig, uniq_bucket: int, spilled: int,
 
 def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
                     shard_index: int, num_shards: int,
-                    last_val=None, val_bucket: int = 0) -> None:
+                    last_val=None, val_bucket: int = 0,
+                    bad_tracker=None) -> None:
     """Multi-process epilogue: final validation AUC via the sharded
     score fn (table stays row-sharded; only binned histograms cross
     hosts), then a size-gated dense export assembled chunk-by-chunk so
@@ -891,7 +936,8 @@ def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
                 cfg, table, cfg.validation_files, mesh, shard_index,
                 num_shards, uniq_bucket=val_bucket,
                 max_batches=cfg.validation_max_batches or None,
-                weight_files=cfg.validation_weight_files)
+                weight_files=cfg.validation_weight_files,
+                bad_lines=bad_tracker)
         if jax.process_index() == 0:
             logger.info("final validation AUC %.6f over %d examples",
                         *last_val)

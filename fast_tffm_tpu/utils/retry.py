@@ -1,0 +1,141 @@
+"""Transient-IO retry with exponential backoff — the data plane's
+"survive the survivable" primitive (README "Fault tolerance").
+
+fast_tffm's production niche is multi-epoch training over huge corpora
+on networked filesystems, where a single transient ``OSError`` on an
+open/read — NFS hiccup, object-store 5xx surfaced through a FUSE
+mount, momentary EIO — would otherwise kill a run that has hours of
+optimizer state behind it. This module wraps exactly those call sites
+(pipeline file opens/reads, weight-sidecar reads, checkpoint
+save/restore) in a bounded retry loop:
+
+- **Retryable vs fatal**: ``OSError``/``TimeoutError`` retry, EXCEPT
+  the definitely-fatal family (``FileNotFoundError``,
+  ``IsADirectoryError``, ``NotADirectoryError``, ``PermissionError``)
+  — a missing input file must stay a loud immediate failure
+  (pipeline.expand_files' contract), not three backoffs followed by
+  the same failure. Everything non-IO (ValueError, ParseError, ...)
+  propagates untouched on the first raise.
+- **Deterministic jitter**: backoff is ``base * 2^attempt`` scaled by
+  a jitter factor drawn from a ``random.Random`` seeded from
+  ``(seed, op)`` — reruns back off identically (the fault-injection
+  harness pins timing-sensitive behavior), while distinct ops
+  de-correlate.
+- **Telemetry**: each retry counts ``io/retries`` (+ per-op
+  ``io/retries/<op>``) and accumulates ``io/retry_sleep_seconds``;
+  the backoff sleep itself is wrapped in an ``obs/trace`` span
+  (``io/retry``) so a retry storm is visible on the run timeline.
+
+Knobs: ``io_retries`` / ``io_backoff_seconds`` in ``[Train]``
+(config.py), threaded here as a ``RetryPolicy``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import random
+import time
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+# Errors that retrying can never fix: the path itself is wrong (or
+# forbidden). FileNotFoundError keeps expand_files' "loud failure on
+# missing file" contract intact even with retries enabled.
+FATAL_IO_ERRORS = (FileNotFoundError, IsADirectoryError,
+                   NotADirectoryError, PermissionError)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Whether a retry has any chance of helping: transient-IO classes
+    (``OSError``/``TimeoutError``) minus the definitely-fatal family."""
+    if isinstance(exc, FATAL_IO_ERRORS):
+        return False
+    return isinstance(exc, (OSError, TimeoutError))
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """How many extra attempts a retryable failure gets, and how long
+    the backoff waits. ``retries`` counts attempts AFTER the first
+    (0 = current fail-fast behavior); sleep before retry k (0-based)
+    is ``backoff_seconds * 2^k * jitter``, jitter uniform in
+    [0.5, 1.5) from a ``(seed, op)``-seeded RNG."""
+    retries: int = 2
+    backoff_seconds: float = 0.1
+    seed: int = 0
+
+    @classmethod
+    def from_config(cls, cfg) -> "RetryPolicy":
+        # getattr defaults: tests and bench build pared-down cfg
+        # objects that predate these knobs.
+        return cls(retries=getattr(cfg, "io_retries", 2),
+                   backoff_seconds=getattr(cfg, "io_backoff_seconds",
+                                           0.1),
+                   seed=getattr(cfg, "seed", 0))
+
+
+def _tel():
+    from fast_tffm_tpu.obs.telemetry import active
+    return active()
+
+
+def retry_io(fn: Callable[..., T], *args,
+             policy: Optional[RetryPolicy] = None, op: str = "io",
+             sleep: Callable[[float], None] = time.sleep,
+             **kwargs) -> T:
+    """Call ``fn(*args, **kwargs)``, retrying retryable IO failures per
+    ``policy`` (None = the default RetryPolicy). ``op`` names the call
+    site in telemetry and seeds the jitter stream; ``sleep`` is
+    injectable so tests pin backoff math without real waits."""
+    from fast_tffm_tpu.obs.trace import span
+    p = policy or RetryPolicy()
+    rng = random.Random(f"{p.seed}/{op}")
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except BaseException as e:
+            if not is_retryable(e) or attempt >= p.retries:
+                raise
+            delay = p.backoff_seconds * (2 ** attempt) * (
+                0.5 + rng.random())
+            tel = _tel()
+            if tel is not None:
+                tel.count("io/retries")
+                tel.count(f"io/retries/{op}")
+                tel.count("io/retry_sleep_seconds", delay)
+            # Timeline visibility: the span brackets the backoff wait,
+            # carrying the error and attempt index — a retry storm
+            # reads as a dense io/retry track in fmtrace.
+            with span("io/retry", op=op, attempt=attempt,
+                      error=f"{type(e).__name__}: {e}"[:200]):
+                if delay > 0:
+                    sleep(delay)
+            attempt += 1
+
+
+def retrying(op: str, policy: Optional[RetryPolicy] = None):
+    """Decorator form of ``retry_io`` for functions that are retryable
+    end-to-end (idempotent reads):
+
+        @retrying("sidecar_read")
+        def _read_sidecar(path): ...
+    """
+    def deco(fn: Callable[..., T]) -> Callable[..., T]:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            return retry_io(fn, *args, policy=policy, op=op, **kwargs)
+        return wrapper
+    return deco
+
+
+def open_with_retry(path: str, mode: str = "r",
+                    policy: Optional[RetryPolicy] = None,
+                    op: str = "open", **kwargs):
+    """``open()`` with transient-failure retry — the one helper the
+    pipeline's file-open sites share so their retry semantics can't
+    drift. A missing file still raises ``FileNotFoundError`` on the
+    first attempt (fatal class)."""
+    return retry_io(open, path, mode, policy=policy, op=op, **kwargs)

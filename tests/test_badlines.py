@@ -1,0 +1,392 @@
+"""Bad-line policy: tolerant parsing (Python + C++ salvage), the
+tracker's counting/quarantine/breaker, pipeline-level skip accounting,
+and the file/lineno provenance in strict-mode ParseErrors."""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.badlines import (MIN_BAD_LINES_TO_TRIP,
+                                         BadInputError, BadLineTracker)
+from fast_tffm_tpu.data.cparser import parse_lines_salvage
+from fast_tffm_tpu.data.parser import ParseError, parse_lines
+from fast_tffm_tpu.data.pipeline import (_fast_path_eligible,
+                                         batch_iterator,
+                                         gil_bound_iteration)
+
+
+def _cfg(tmp_path, train_file, **overrides):
+    base = dict(vocabulary_size=50, factor_num=2, batch_size=8,
+                epoch_num=1, shuffle=False,
+                train_files=(str(train_file),),
+                model_file=str(tmp_path / "model" / "fm"))
+    base.update(overrides)
+    return FmConfig(**base)
+
+
+def _write(path, lines):
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+GOOD = [f"1 {i % 40}:1.0 {(i + 3) % 40}:0.5" for i in range(40)]
+
+
+# --- parser tolerant mode -----------------------------------------------
+
+
+def test_parse_lines_tolerant_skips_and_records():
+    bads = []
+    lines = list(GOOD[:3]) + ["x 1:1", "0 2:zz"] + list(GOOD[3:6])
+    block = parse_lines(lines, 50, bad_lines=bads)
+    assert block.batch_size == 6
+    assert [b[0] for b in bads] == [3, 4]
+    assert "bad label" in bads[0][2] and "bad value" in bads[1][2]
+
+
+def test_parse_lines_tolerant_rolls_back_partial_example():
+    # The bad token is mid-line: the label and the first good token
+    # must not leak into the block.
+    bads = []
+    block = parse_lines(["1 2:1.0 3:zz 4:1.0", "0 5:2.0"], 50,
+                        bad_lines=bads)
+    assert block.batch_size == 1
+    assert block.labels.tolist() == [0.0]
+    assert block.ids.tolist() == [5]
+    assert len(bads) == 1
+
+
+def test_parse_lines_strict_mode_unchanged():
+    with pytest.raises(ParseError, match="line 1"):
+        parse_lines(["0 1:1", "nope"], 50)
+
+
+def test_salvage_matches_python_on_good_lines():
+    bads = []
+    lines = list(GOOD[:5]) + ["##broken##"] + list(GOOD[5:9])
+    got = parse_lines_salvage(lines, 50, bad_lines=bads)
+    want = parse_lines(list(GOOD[:9]), 50)
+    assert len(bads) == 1 and bads[0][0] == 5
+    np.testing.assert_array_equal(got.labels, want.labels)
+    np.testing.assert_array_equal(got.poses, want.poses)
+    np.testing.assert_array_equal(got.ids, want.ids)
+    np.testing.assert_array_equal(got.vals, want.vals)
+
+
+def test_salvage_clean_block_uses_fast_path_output():
+    got = parse_lines_salvage(list(GOOD[:4]), 50, bad_lines=[])
+    want = parse_lines(list(GOOD[:4]), 50)
+    np.testing.assert_array_equal(got.ids, want.ids)
+
+
+# --- tracker -------------------------------------------------------------
+
+
+def test_tracker_breaker_names_worst_file(tmp_path):
+    t = BadLineTracker("skip", max_bad_fraction=0.01)
+    t.count_ok(100)
+    for i in range(MIN_BAD_LINES_TO_TRIP - 1):
+        t.record("ok_ish.txt" if i == 0 else "rotten.txt", i + 1,
+                 "raw", "err")
+    with pytest.raises(BadInputError) as ei:
+        t.record("rotten.txt", 99, "raw", "err")
+    msg = str(ei.value)
+    assert "rotten.txt" in msg and "max_bad_fraction" in msg
+
+
+def test_tracker_below_floor_never_trips():
+    t = BadLineTracker("skip", max_bad_fraction=0.0)
+    for i in range(MIN_BAD_LINES_TO_TRIP - 1):
+        t.record("f.txt", i + 1, "raw", "err")  # 100% bad, under floor
+
+
+def test_tracker_quarantine_dedupes(tmp_path):
+    q = str(tmp_path / "q.jsonl")
+    t = BadLineTracker("quarantine", 1.0, quarantine_file=q)
+    t.count_ok(1000)
+    for _ in range(3):  # same line seen on three epochs
+        t.record("f.txt", 7, "raw line", "bad label")
+    t.record("f.txt", 9, "other", "bad value")
+    t.close()
+    recs = [json.loads(ln) for ln in open(q)]
+    assert [(r["file"], r["lineno"]) for r in recs] == [
+        ("f.txt", 7), ("f.txt", 9)]
+    assert recs[0]["raw"] == "raw line"
+    assert t.bad == 4  # every occurrence still counts
+
+
+def test_tracker_health_events_rate_limited(tmp_path):
+    from fast_tffm_tpu.obs.sink import read_events
+    from fast_tffm_tpu.obs.telemetry import RunTelemetry, activate
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+    t = BadLineTracker("skip", 1.0)
+    t.count_ok(10000)
+    with activate(tel):
+        for i in range(100):
+            t.record("f.txt", i + 1, "raw", "err")
+    tel.close(0)
+    evs = [e for e in read_events(path)
+           if e.get("event") == "health"
+           and e.get("status") == "bad_input"]
+    # Power-of-two schedule: bad counts 1, 2, 4, 8, 16, 32, 64 emit.
+    assert [e["bad_lines"] for e in evs] == [1, 2, 4, 8, 16, 32, 64]
+    assert tel.registry.snapshot()["counters"][
+        "pipeline/bad_lines"] == 100
+
+
+# --- pipeline integration ------------------------------------------------
+
+
+def test_pipeline_skip_counts_exact(tmp_path):
+    lines = list(GOOD)
+    lines[5] = "x bad"
+    lines[17] = "0 3:zz"
+    p = _write(tmp_path / "t.txt", lines)
+    cfg = _cfg(tmp_path, p, bad_line_policy="skip",
+               max_bad_fraction=0.5)
+    n = sum(b.num_real for b in batch_iterator(cfg, [p], epochs=1))
+    assert n == len(lines) - 2
+
+
+def test_pipeline_quarantine_records_absolute_linenos(tmp_path):
+    lines = list(GOOD)
+    lines[11] = "##garbage##"
+    p = _write(tmp_path / "t.txt", lines)
+    cfg = _cfg(tmp_path, p, bad_line_policy="quarantine",
+               max_bad_fraction=0.5)
+    list(batch_iterator(cfg, [p], epochs=1))
+    from fast_tffm_tpu.data.badlines import quarantine_path
+    recs = [json.loads(ln) for ln in open(quarantine_path(cfg))]
+    assert [(r["file"], r["lineno"]) for r in recs] == [(p, 12)]
+    assert recs[0]["raw"] == "##garbage##"
+
+
+def test_pipeline_breaker_aborts_naming_file(tmp_path):
+    lines = ["completely broken"] * 30 + list(GOOD[:10])
+    p = _write(tmp_path / "rot.txt", lines)
+    cfg = _cfg(tmp_path, p, bad_line_policy="skip",
+               max_bad_fraction=0.01)
+    with pytest.raises(BadInputError, match="rot.txt"):
+        list(batch_iterator(cfg, [p], epochs=1))
+
+
+def test_keep_empty_skip_preserves_line_alignment(tmp_path):
+    # Predict's contract: one example per input line even when a line
+    # is bad — it becomes a zero-feature example, never a dropped row.
+    lines = list(GOOD[:10])
+    lines[4] = "broken line here"
+    p = _write(tmp_path / "t.txt", lines)
+    cfg = _cfg(tmp_path, p, bad_line_policy="skip",
+               max_bad_fraction=0.5)
+    n = sum(b.num_real for b in batch_iterator(
+        cfg, [p], training=False, epochs=1, keep_empty=True))
+    assert n == len(lines)
+
+
+def test_multi_epoch_run_scoped_tracker(tmp_path):
+    lines = list(GOOD)
+    lines[3] = "zzz"
+    p = _write(tmp_path / "t.txt", lines)
+    cfg = _cfg(tmp_path, p, bad_line_policy="skip",
+               max_bad_fraction=0.5)
+    tracker = BadLineTracker.from_config(cfg)
+    for _ in range(3):
+        list(batch_iterator(cfg, [p], epochs=1, bad_lines=tracker))
+    assert tracker.bad == 3
+    assert tracker.total == 3 * len(lines)
+    tracker.close()
+
+
+# --- strict-mode provenance (satellite: findable bad lines) -------------
+
+
+def test_fast_path_error_names_file_and_line(tmp_path):
+    a = _write(tmp_path / "a.txt", GOOD[:20])
+    lines = list(GOOD[:15])
+    lines[6] = "1 3:bogus_value"
+    b = _write(tmp_path / "b.txt", lines)
+    cfg = _cfg(tmp_path, a)
+    with pytest.raises(ParseError) as ei:
+        list(batch_iterator(cfg, [a, b], epochs=1))
+    msg = str(ei.value)
+    assert f"{b} line 7" in msg, msg
+    assert "bogus_value" in msg
+
+
+def test_generic_path_error_names_file_and_line(tmp_path):
+    # Weight sidecars force the generic (per-line Python) path.
+    lines = list(GOOD[:12])
+    lines[9] = "x no good"
+    p = _write(tmp_path / "t.txt", lines)
+    w = _write(tmp_path / "t.weights", ["1.0"] * len(lines))
+    cfg = _cfg(tmp_path, p)
+    with pytest.raises(ParseError) as ei:
+        list(batch_iterator(cfg, [p], weight_files=[w], epochs=1))
+    assert f"{p} line 10" in str(ei.value), str(ei.value)
+
+
+def test_sharded_error_carries_shard_note(tmp_path):
+    lines = list(GOOD)
+    lines[35] = "###"
+    p = _write(tmp_path / "t.txt", lines)
+    cfg = _cfg(tmp_path, p)
+    raised = None
+    for shard in range(2):
+        try:
+            list(batch_iterator(cfg, [p], epochs=1, shard_index=shard,
+                                num_shards=2))
+        except ParseError as e:
+            raised = str(e)
+    assert raised is not None
+    assert f"{p} line 36" in raised, raised
+    assert "shard" in raised
+
+
+# --- routing + config ----------------------------------------------------
+
+
+def test_tolerant_policy_gates_off_streaming_fast_path(tmp_path):
+    cfg = _cfg(tmp_path, "x")
+    assert _fast_path_eligible(cfg, ())
+    tol = dataclasses.replace(cfg, bad_line_policy="skip")
+    assert not _fast_path_eligible(tol, ())
+    # gil_bound answer stays consistent with the path actually taken.
+    assert gil_bound_iteration(tol) or not os.path.exists(
+        os.path.join(os.path.dirname(__file__), "..", "fast_tffm_tpu",
+                     "data", "_parser.so"))
+
+
+def test_config_rejects_bad_knobs(tmp_path):
+    with pytest.raises(ValueError, match="bad_line_policy"):
+        FmConfig(bad_line_policy="ignore")
+    with pytest.raises(ValueError, match="max_bad_fraction"):
+        FmConfig(max_bad_fraction=1.5)
+    with pytest.raises(ValueError, match="io_retries"):
+        FmConfig(io_retries=-1)
+    with pytest.raises(ValueError, match="io_backoff_seconds"):
+        FmConfig(io_backoff_seconds=-0.1)
+
+
+def test_config_file_accepts_fault_knobs(tmp_path):
+    from fast_tffm_tpu.config import load_config
+    cfg_path = tmp_path / "fm.cfg"
+    cfg_path.write_text(
+        "[Train]\nbad_line_policy = quarantine\n"
+        "max_bad_fraction = 0.05\nio_retries = 4\n"
+        "io_backoff_seconds = 0.25\n")
+    cfg = load_config(str(cfg_path))
+    assert cfg.bad_line_policy == "quarantine"
+    assert cfg.max_bad_fraction == 0.05
+    assert cfg.io_retries == 4
+    assert cfg.io_backoff_seconds == 0.25
+
+
+# --- review-fix regressions ---------------------------------------------
+
+
+def test_chunk_read_retry_never_skips_bytes(tmp_path):
+    """A partial buffered read advances the file position before
+    raising; the retry must seek back to the chunk start or bytes are
+    silently lost (truncated/merged lines — corrupted training data)."""
+    import builtins
+    import errno
+    from fast_tffm_tpu.data.pipeline import _iter_owned_chunks
+    from fast_tffm_tpu.utils.retry import RetryPolicy
+    p = tmp_path / "t.txt"
+    content = b"".join(b"%d 1:1.0 2:0.5\n" % i for i in range(2000))
+    p.write_bytes(content)
+
+    class PartialThenFail:
+        """File wrapper: the first read consumes some bytes, then
+        raises a retryable OSError — the NFS partial-read shape."""
+
+        def __init__(self, fh):
+            self.fh = fh
+            self.fired = False
+
+        def read(self, n=-1):
+            if not self.fired:
+                self.fired = True
+                self.fh.read(37)  # advance underlying position
+                raise OSError(errno.EIO, "injected partial read")
+            return self.fh.read(n)
+
+        def seek(self, *a):
+            return self.fh.seek(*a)
+
+        def tell(self):
+            return self.fh.tell()
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            self.fh.close()
+
+    real_open = builtins.open
+
+    def wrapping(file, *a, **k):
+        fh = real_open(file, *a, **k)
+        if str(file) == str(p):
+            return PartialThenFail(fh)
+        return fh
+
+    builtins.open = wrapping
+    try:
+        got = b"".join(_iter_owned_chunks(
+            str(p), 0, len(content),
+            retry=RetryPolicy(retries=2, backoff_seconds=0.0)))
+    finally:
+        builtins.open = real_open
+    assert got == content
+
+
+def test_spill_requeue_does_not_double_count(tmp_path):
+    """A UniqOverflow spill requeues the chunk tail; those lines must
+    not pass through the tracker twice (inflated totals would dilute
+    the breaker and break skip-count == injected-count)."""
+    from fast_tffm_tpu.data.pipeline import batch_iterator
+    n_lines, feats = 32, 40
+    lines = [" ".join(["1"] + [f"{i * feats + j}:1.0"
+                               for j in range(feats)])
+             for i in range(n_lines)]
+    lines[5] = "##bad##"
+    lines[20] = "1 0:##bad##"
+    p = _write(tmp_path / "dense.txt", lines)
+    cfg = _cfg(tmp_path, p, vocabulary_size=n_lines * feats,
+               bad_line_policy="skip", max_bad_fraction=0.5,
+               max_features_per_example=feats, batch_size=8)
+    tracker = BadLineTracker.from_config(cfg)
+    batches = list(batch_iterator(cfg, [p], epochs=1,
+                                  fixed_shape=True, uniq_bucket=64,
+                                  bad_lines=tracker))
+    # Spills definitely happened: 8 lines x 40 uniques >> 64.
+    assert len(batches) > (n_lines - 2 + 7) // 8
+    assert sum(b.num_real for b in batches) == n_lines - 2
+    assert tracker.total == n_lines, tracker.total
+    assert tracker.bad == 2
+    tracker.close()
+
+
+def test_validation_sweeps_share_run_tracker(tmp_path):
+    """train()'s per-epoch validation sweeps must reuse the run-scoped
+    tracker: the same bad validation line across N epochs quarantines
+    ONCE (per-sweep fresh trackers would append it every epoch)."""
+    from fast_tffm_tpu.data.badlines import quarantine_path
+    from fast_tffm_tpu.train import train
+    tlines = list(GOOD)
+    vlines = list(GOOD[:16])
+    vlines[3] = "##bad validation line##"
+    tp = _write(tmp_path / "train.txt", tlines)
+    vp = _write(tmp_path / "val.txt", vlines)
+    cfg = _cfg(tmp_path, tp, bad_line_policy="quarantine",
+               max_bad_fraction=0.5, epoch_num=3,
+               validation_files=(vp,))
+    train(cfg)
+    recs = [json.loads(ln) for ln in open(quarantine_path(cfg))]
+    assert [(r["file"], r["lineno"]) for r in recs] == [(vp, 4)]

@@ -1,0 +1,15 @@
+"""Tier-1 wiring for the fmchaos fault-injection soaks: each scenario
+runs a real (tiny) training job under one injected fault and asserts
+the documented recovery behavior — the asserts live in
+tools/fmchaos/__init__.py so `make chaos`, CI, and this suite pin the
+exact same contracts."""
+
+import pytest
+
+from tools.fmchaos import SCENARIOS
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_chaos_scenario(name, tmp_path):
+    detail = SCENARIOS[name](str(tmp_path))
+    assert isinstance(detail, str) and detail

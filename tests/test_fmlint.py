@@ -188,3 +188,76 @@ def test_r003_flags_bare_name_and_respects_pragma(tmp_path):
     """)
     found = run_file(path)
     assert [(f.rule, f.line) for f in found] == [("R003", 6)]
+
+
+def test_r004_flags_swallowed_broad_except(tmp_path):
+    """ISSUE 4 satellite: bare `except Exception: pass` in hot modules
+    turns failures the fault-tolerance layer should count/surface into
+    silence."""
+    path = _hot_file(tmp_path, """\
+        def run(it):
+            for x in it:
+                try:
+                    do(x)
+                except Exception:
+                    pass
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R004"]
+    assert found[0].line == 5
+
+
+def test_r004_flags_bare_except_continue(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(it):
+            for x in it:
+                try:
+                    do(x)
+                except:
+                    continue
+    """)
+    assert [f.rule for f in run_file(path)] == ["R004"]
+
+
+def test_r004_flags_broad_tuple(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(x):
+            try:
+                do(x)
+            except (ValueError, Exception):
+                pass
+    """)
+    assert [f.rule for f in run_file(path)] == ["R004"]
+
+
+def test_r004_allows_narrow_handlers(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(x):
+            try:
+                do(x)
+            except (OSError, RuntimeError):
+                pass
+    """)
+    assert run_file(path) == []
+
+
+def test_r004_allows_handled_broad_except(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(x, log):
+            try:
+                do(x)
+            except Exception:
+                log.exception("do failed")
+    """)
+    assert run_file(path) == []
+
+
+def test_r004_respects_pragma(tmp_path):
+    path = _hot_file(tmp_path, """\
+        def run(x):
+            try:
+                do(x)
+            except Exception:  # fmlint: disable=R004 -- must outlive
+                pass
+    """)
+    assert run_file(path) == []

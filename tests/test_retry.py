@@ -1,0 +1,148 @@
+"""utils/retry.py: retryable-vs-fatal classification, backoff/jitter
+determinism, telemetry counters, and the open helper under injected
+transient failures (fast_tffm_tpu/testing/faults.py)."""
+
+import errno
+
+import pytest
+
+from fast_tffm_tpu.testing.faults import flaky_open
+from fast_tffm_tpu.utils.retry import (RetryPolicy, is_retryable,
+                                       open_with_retry, retry_io,
+                                       retrying)
+
+
+class Flaky:
+    """Callable failing the first n calls with the given error."""
+
+    def __init__(self, n, exc_factory):
+        self.n, self.exc_factory = n, exc_factory
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.n:
+            raise self.exc_factory()
+        return "ok"
+
+
+def test_transient_oserror_retried():
+    sleeps = []
+    fn = Flaky(2, lambda: OSError(errno.EIO, "flake"))
+    out = retry_io(fn, policy=RetryPolicy(retries=3, backoff_seconds=0.1),
+                   op="t", sleep=sleeps.append)
+    assert out == "ok"
+    assert fn.calls == 3
+    assert len(sleeps) == 2
+    # Exponential envelope with jitter in [0.5, 1.5): attempt k sleeps
+    # within [0.5, 1.5) * 0.1 * 2^k.
+    assert 0.05 <= sleeps[0] < 0.15
+    assert 0.10 <= sleeps[1] < 0.30
+
+
+def test_timeout_error_retried():
+    fn = Flaky(1, TimeoutError)
+    assert retry_io(fn, policy=RetryPolicy(retries=1),
+                    op="t", sleep=lambda _: None) == "ok"
+    assert fn.calls == 2
+
+
+@pytest.mark.parametrize("exc_factory", [
+    lambda: FileNotFoundError("gone"),
+    lambda: PermissionError("no"),
+    lambda: IsADirectoryError("dir"),
+])
+def test_fatal_io_family_never_retried(exc_factory):
+    fn = Flaky(5, exc_factory)
+    with pytest.raises(OSError):
+        retry_io(fn, policy=RetryPolicy(retries=5), op="t",
+                 sleep=lambda _: None)
+    assert fn.calls == 1
+
+
+def test_non_io_errors_never_retried():
+    fn = Flaky(5, lambda: ValueError("logic bug"))
+    with pytest.raises(ValueError):
+        retry_io(fn, policy=RetryPolicy(retries=5), op="t",
+                 sleep=lambda _: None)
+    assert fn.calls == 1
+
+
+def test_retries_exhausted_reraises_last():
+    fn = Flaky(10, lambda: OSError(errno.EIO, "still down"))
+    with pytest.raises(OSError, match="still down"):
+        retry_io(fn, policy=RetryPolicy(retries=2), op="t",
+                 sleep=lambda _: None)
+    assert fn.calls == 3  # 1 + retries
+
+
+def test_jitter_deterministic_per_seed_and_op():
+    def run(seed, op):
+        sleeps = []
+        retry_io(Flaky(3, lambda: OSError(errno.EIO, "x")),
+                 policy=RetryPolicy(retries=3, seed=seed), op=op,
+                 sleep=sleeps.append)
+        return sleeps
+    assert run(7, "a") == run(7, "a")       # reruns replay exactly
+    assert run(7, "a") != run(7, "b")       # ops de-correlate
+    assert run(7, "a") != run(8, "a")       # seeds de-correlate
+
+
+def test_is_retryable_classification():
+    assert is_retryable(OSError(errno.EIO, "x"))
+    assert is_retryable(TimeoutError())
+    assert is_retryable(ConnectionResetError())  # OSError subclass
+    assert not is_retryable(FileNotFoundError("x"))
+    assert not is_retryable(KeyboardInterrupt())
+    assert not is_retryable(ValueError("x"))
+
+
+def test_retrying_decorator():
+    calls = []
+
+    @retrying("deco", policy=RetryPolicy(retries=1,
+                                         backoff_seconds=0.0))
+    def sometimes(x):
+        calls.append(x)
+        if len(calls) == 1:
+            raise OSError(errno.EIO, "first")
+        return x * 2
+
+    assert sometimes(21) == 42
+    assert calls == [21, 21]
+
+
+def test_open_with_retry_absorbs_flaky_open(tmp_path):
+    p = tmp_path / "data.txt"
+    p.write_text("hello\n")
+    with flaky_open(2, match="data.txt") as state:
+        fh = open_with_retry(str(p), "r",
+                             policy=RetryPolicy(retries=2,
+                                                backoff_seconds=0.0),
+                             op="test_open")
+        with fh:
+            assert fh.read() == "hello\n"
+    assert state["failures"] == 2
+
+
+def test_open_with_retry_missing_file_fails_fast(tmp_path):
+    calls = []
+    with pytest.raises(FileNotFoundError):
+        retry_io(open, str(tmp_path / "nope.txt"),
+                 policy=RetryPolicy(retries=3),
+                 op="t", sleep=calls.append)
+    assert calls == []  # no backoff was paid
+
+
+def test_retry_counters_reach_active_telemetry(tmp_path):
+    from fast_tffm_tpu.obs.telemetry import RunTelemetry, activate
+    tel = RunTelemetry(str(tmp_path / "m.jsonl"), meta={})
+    with activate(tel):
+        retry_io(Flaky(2, lambda: OSError(errno.EIO, "x")),
+                 policy=RetryPolicy(retries=2), op="unit",
+                 sleep=lambda _: None)
+    tel.close(0)
+    snap = tel.registry.snapshot()["counters"]
+    assert snap["io/retries"] == 2
+    assert snap["io/retries/unit"] == 2
+    assert snap["io/retry_sleep_seconds"] > 0

@@ -22,8 +22,8 @@ from fast_tffm_tpu.data.pipeline import (_iter_lines, batch_iterator,
 
 def _shard_lines(path, num_shards, keep_empty=False):
     return [
-        [line for line, _ in _iter_lines([path], (), i, num_shards,
-                                         keep_empty=keep_empty)]
+        [line for line, _, _ in _iter_lines([path], (), i, num_shards,
+                                            keep_empty=keep_empty)]
         for i in range(num_shards)
     ]
 
@@ -229,8 +229,8 @@ def test_weighted_byte_range_partition(tmp_path):
         for i in range(num_shards):
             got.extend(
                 (line.rstrip("\n"), w)
-                for line, w in _iter_lines([str(data)], [str(wts)],
-                                           i, num_shards))
+                for line, w, _ in _iter_lines([str(data)], [str(wts)],
+                                              i, num_shards))
         assert [g[0] for g in got] == [e[0] for e in expected], num_shards
         assert [g[1] for g in got] == pytest.approx(
             [e[1] for e in expected]), num_shards

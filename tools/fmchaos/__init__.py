@@ -1,0 +1,286 @@
+"""fmchaos — end-to-end fault-injection soak scenarios for the data
+plane (README "Fault tolerance").
+
+    python -m tools.fmchaos               # run every scenario
+    python -m tools.fmchaos skip preempt-resume
+    python -m tools.fmchaos --list
+    make chaos                            # the CI target (CPU)
+
+Each scenario builds a tiny synthetic corpus, runs a REAL training job
+through ``fast_tffm_tpu.train.train`` under one injected fault
+(``fast_tffm_tpu/testing/faults.py`` — all deterministic/seeded), and
+asserts the documented recovery behavior:
+
+- ``skip``            0.5% corrupt lines + ``bad_line_policy = skip``
+                      → trains to completion; the skip count equals
+                      the injected corruption exactly.
+- ``quarantine``      same corpus, 2 epochs → quarantine sidecar holds
+                      each bad line ONCE (file/lineno/raw), while the
+                      skip counter counts both epochs' passes.
+- ``max-bad``         10% corruption trips the ``max_bad_fraction``
+                      breaker → the run aborts naming the worst file.
+- ``flaky-open``      the first 2 opens of the train file raise EIO →
+                      the retry/backoff layer absorbs them; retry
+                      counters land in the metrics stream.
+- ``preempt-resume``  SIGTERM mid-epoch → the run saves and exits
+                      cleanly, ``fmstat`` reports PREEMPTED (not
+                      CRASHED); a restart resumes the interrupted
+                      epoch schedule and finishes OK.
+
+The scenario functions are plain callables (workdir in, asserts
+inside) so tests/test_chaos.py runs the same soaks under tier-1; the
+CLI adds CPU forcing and PASS/FAIL reporting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def _write_corpus(path: str, n: int, seed: int,
+                  vocab: int = 200, informative: int = 6) -> None:
+    """Separable synthetic libsvm corpus (the e2e smoke shape): label-1
+    examples prefer ids [0, informative), label-0 prefer the next
+    block; a few noise features with float values exercise value
+    parsing."""
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        y = int(rng.integers(0, 2))
+        base = 0 if y else informative
+        feats = {int(base + rng.integers(0, informative)): 1.0,
+                 int(base + rng.integers(0, informative)): 1.0}
+        for _ in range(3):
+            feats[int(rng.integers(2 * informative, vocab))] = round(
+                float(rng.uniform(0.5, 1.5)), 3)
+        toks = " ".join(f"{i}:{v}" for i, v in sorted(feats.items()))
+        lines.append(f"{y} {toks}")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
+def _cfg(workdir: str, train_file: str, **overrides):
+    from fast_tffm_tpu.config import FmConfig
+    base = dict(
+        vocabulary_size=200, factor_num=4, batch_size=32, epoch_num=1,
+        learning_rate=0.1, shuffle=True, seed=0, log_steps=0,
+        train_files=(train_file,),
+        model_file=os.path.join(workdir, "model", "fm"),
+        log_file=os.path.join(workdir, "chaos.log"),
+        metrics_file=os.path.join(workdir, "metrics.jsonl"),
+        metrics_flush_steps=5, io_backoff_seconds=0.01)
+    base.update(overrides)
+    return FmConfig(**base)
+
+
+def _summary(cfg):
+    from fast_tffm_tpu.obs.attribution import summarize
+    return summarize([cfg.metrics_file])
+
+
+def _counters(cfg) -> dict:
+    return _summary(cfg).get("counters", {})
+
+
+def _verdict(cfg) -> str:
+    from fast_tffm_tpu.obs.attribution import health_verdict
+    return health_verdict(_summary(cfg))["verdict"]
+
+
+# --- scenarios -----------------------------------------------------------
+
+
+def scenario_skip(workdir: str, seed: int = 0) -> str:
+    """0.5% corrupt lines, policy=skip: completes; counts pin exactly."""
+    from fast_tffm_tpu.testing.faults import corrupt_corpus
+    from fast_tffm_tpu.train import train
+    clean = os.path.join(workdir, "clean.txt")
+    dirty = os.path.join(workdir, "train_skip.txt")
+    _write_corpus(clean, 400, seed)
+    bad = corrupt_corpus(clean, dirty, fraction=0.005, seed=seed)
+    cfg = _cfg(workdir, dirty, bad_line_policy="skip")
+    train(cfg)
+    c = _counters(cfg)
+    assert c.get("pipeline/bad_lines") == len(bad), (
+        f"skip count {c.get('pipeline/bad_lines')} != injected "
+        f"{len(bad)}")
+    assert c.get("train/examples") == 400 - len(bad), (
+        f"trained examples {c.get('train/examples')} != "
+        f"{400 - len(bad)}")
+    assert _verdict(cfg) == "OK", _verdict(cfg)
+    return (f"skipped {len(bad)}/400 injected bad lines, trained "
+            f"{int(c['train/examples'])} examples, verdict OK")
+
+
+def scenario_quarantine(workdir: str, seed: int = 0) -> str:
+    """Quarantine sidecar holds each injected bad line once (dedup
+    across 2 epochs) with exact file/lineno/raw provenance."""
+    from fast_tffm_tpu.testing.faults import corrupt_corpus
+    from fast_tffm_tpu.train import train
+    clean = os.path.join(workdir, "clean.txt")
+    dirty = os.path.join(workdir, "train_quar.txt")
+    _write_corpus(clean, 400, seed)
+    bad = corrupt_corpus(clean, dirty, fraction=0.005, seed=seed)
+    cfg = _cfg(workdir, dirty, bad_line_policy="quarantine",
+               epoch_num=2)
+    train(cfg)
+    qpath = cfg.metrics_file + ".quarantine"
+    with open(qpath) as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    dirty_lines = open(dirty).read().splitlines()
+    assert sorted(r["lineno"] for r in recs) == [i + 1 for i in bad], (
+        f"quarantined linenos {sorted(r['lineno'] for r in recs)} != "
+        f"injected {[i + 1 for i in bad]}")
+    for r in recs:
+        assert r["file"] == dirty
+        assert r["raw"] == dirty_lines[r["lineno"] - 1]
+        assert r["error"]
+    c = _counters(cfg)
+    assert c.get("pipeline/bad_lines") == 2 * len(bad)  # both epochs
+    return (f"quarantined {len(recs)} line(s) once across 2 epochs "
+            f"({int(c['pipeline/bad_lines'])} skips counted) to "
+            f"{os.path.basename(qpath)}")
+
+
+def scenario_max_bad(workdir: str, seed: int = 0) -> str:
+    """10% corruption trips the breaker; the error names the file."""
+    from fast_tffm_tpu.data.badlines import BadInputError
+    from fast_tffm_tpu.testing.faults import corrupt_corpus
+    from fast_tffm_tpu.train import train
+    clean = os.path.join(workdir, "clean.txt")
+    dirty = os.path.join(workdir, "train_rotten.txt")
+    _write_corpus(clean, 400, seed)
+    corrupt_corpus(clean, dirty, fraction=0.10, seed=seed)
+    cfg = _cfg(workdir, dirty, bad_line_policy="skip")
+    try:
+        train(cfg)
+    except BadInputError as e:
+        assert dirty in str(e), f"breaker error must name the file: {e}"
+        assert "max_bad_fraction" in str(e)
+        return f"breaker tripped naming {os.path.basename(dirty)}"
+    raise AssertionError("max_bad_fraction breaker never tripped on a "
+                         "10%-corrupt corpus")
+
+
+def scenario_flaky_open(workdir: str, seed: int = 0) -> str:
+    """2 transient open failures on the train file: the run completes
+    and the retries are visible in the metrics stream."""
+    from fast_tffm_tpu.testing.faults import flaky_open
+    from fast_tffm_tpu.train import train
+    data = os.path.join(workdir, "train_flaky.txt")
+    _write_corpus(data, 400, seed)
+    cfg = _cfg(workdir, data, io_retries=3)
+    with flaky_open(2, match="train_flaky.txt") as state:
+        train(cfg)
+    assert state["failures"] == 2, state
+    c = _counters(cfg)
+    assert c.get("io/retries", 0) >= 2, c.get("io/retries")
+    assert _verdict(cfg) == "OK", _verdict(cfg)
+    return (f"absorbed {state['failures']} injected open failures "
+            f"({int(c['io/retries'])} retries in the metrics stream)")
+
+
+def scenario_preempt_resume(workdir: str, seed: int = 0) -> str:
+    """SIGTERM mid-epoch: clean save-and-exit, fmstat says PREEMPTED;
+    a restart resumes the interrupted schedule and finishes OK."""
+    from fast_tffm_tpu.checkpoint import CheckpointState
+    from fast_tffm_tpu.testing.faults import preempt_after_steps
+    from fast_tffm_tpu.train import (checkpoint_template,
+                                     resume_start_epoch, train)
+    data = os.path.join(workdir, "train_preempt.txt")
+    _write_corpus(data, 400, seed)
+    cfg = _cfg(workdir, data, epoch_num=3)
+    # 400/32 -> 13 steps per epoch; step 16 is mid-epoch 1.
+    with preempt_after_steps(16) as state:
+        train(cfg)
+    assert state["fired"], "SIGTERM injector never fired"
+    assert _verdict(cfg) == "PREEMPTED", _verdict(cfg)
+    ckpt = CheckpointState(cfg.model_file)
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    ckpt.close()
+    epoch = int(restored["epoch"])
+    assert 0 < epoch < cfg.epoch_num, (
+        f"preemption checkpoint records {epoch} completed epochs; "
+        f"expected mid-schedule (0 < e < {cfg.epoch_num})")
+    assert resume_start_epoch(epoch, cfg.epoch_num) == epoch
+    # Restart without the fault: resumes and completes the schedule.
+    train(cfg)
+    log = open(cfg.log_file).read()
+    assert "resuming interrupted epoch schedule" in log
+    assert _verdict(cfg) == "OK", _verdict(cfg)  # latest run segment
+    ckpt = CheckpointState(cfg.model_file)
+    restored = ckpt.restore(template=checkpoint_template(cfg))
+    ckpt.close()
+    assert int(restored["epoch"]) == cfg.epoch_num
+    return (f"preempted at step {state['steps']} (epoch {epoch} "
+            f"recorded), PREEMPTED verdict, resumed to "
+            f"{cfg.epoch_num}/{cfg.epoch_num} epochs")
+
+
+SCENARIOS: Dict[str, Callable[..., str]] = {
+    "skip": scenario_skip,
+    "quarantine": scenario_quarantine,
+    "max-bad": scenario_max_bad,
+    "flaky-open": scenario_flaky_open,
+    "preempt-resume": scenario_preempt_resume,
+}
+
+
+def main(argv: List[str] = None) -> int:
+    import argparse
+    import sys
+    import tempfile
+    ap = argparse.ArgumentParser(
+        prog="fmchaos", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("scenarios", nargs="*",
+                    help="scenario names (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", default=None,
+                    help="keep artifacts here instead of a tempdir")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name in SCENARIOS:
+            print(name)
+        return 0
+    # The chaos soaks run on CPU by contract (`make chaos` in CI): the
+    # fault paths under test are host-side, and the scenarios must run
+    # on machines with no accelerator.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    names = args.scenarios or list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"fmchaos: unknown scenario(s) {unknown}; "
+              f"known: {list(SCENARIOS)}", file=sys.stderr)
+        return 2
+    failures = 0
+    for name in names:
+        if args.workdir:
+            wd = os.path.join(args.workdir, name.replace("-", "_"))
+            os.makedirs(wd, exist_ok=True)
+            ctx = None
+        else:
+            ctx = tempfile.TemporaryDirectory(prefix=f"fmchaos_{name}_")
+            wd = ctx.name
+        try:
+            detail = SCENARIOS[name](wd, seed=args.seed)
+            print(f"PASS {name}: {detail}")
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            failures += 1
+            print(f"FAIL {name}: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        finally:
+            if ctx is not None:
+                ctx.cleanup()
+    print(f"fmchaos: {len(names) - failures}/{len(names)} scenarios "
+          "passed")
+    return 1 if failures else 0

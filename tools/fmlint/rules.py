@@ -119,4 +119,47 @@ def r003_raw_perf_counter(path: str, tree: ast.AST) -> List[Finding]:
     return found
 
 
-RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter)
+def _is_broad_handler(node: ast.ExceptHandler) -> bool:
+    """Bare ``except:``, ``except Exception:``/``BaseException:``, or a
+    tuple containing either."""
+    t = node.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    elif isinstance(t, ast.Name):
+        names = [t.id]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def r004_swallowed_exception(path: str, tree: ast.AST) -> List[Finding]:
+    """Broad swallow-and-continue in hot modules: a bare/``Exception``
+    handler whose body is only ``pass``/``continue`` turns an
+    unexpected failure — a wedged filesystem, a poisoned batch, a
+    telemetry bug — into silence exactly where the fault-tolerance
+    layer needs a counter, a health event, or a loud abort
+    (data/badlines.py, utils/retry.py give it both). Narrow handlers
+    (``except ParseError:``, ``except FileNotFoundError:``) are fine:
+    they document the one expected failure they absorb. Deliberate
+    broad swallows (a watchdog that must outlive its own bugs) carry
+    a justified pragma."""
+    if not is_hot_module(path):
+        return []
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        body_swallows = all(isinstance(s, (ast.Pass, ast.Continue))
+                            for s in node.body)
+        if body_swallows and _is_broad_handler(node):
+            found.append(Finding(
+                "R004", path, node.lineno,
+                "broad except swallows and continues; narrow the "
+                "exception type, count/emit the failure (obs/, "
+                "data/badlines), or justify with a pragma"))
+    return found
+
+
+RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter,
+         r004_swallowed_exception)
