@@ -5,19 +5,237 @@ through the managed session, restore-on-restart, final model at the
 config's ``model_file`` path; predict restores the same. Same contract
 here, with orbax's sharding-aware async-capable machinery underneath plus
 a dense ``.npz`` exporter for parity checks outside JAX.
+
+Self-healing state plane (README "Checkpoint integrity & fallback"):
+every committed save gets an atomically-renamed ``manifest-<step>.json``
+sidecar (per-file size + crc32, step/epoch/vocab echo), written by
+process 0 once the step directory is finalized. Restore verifies the
+candidate step against its manifest first (``ckpt_verify = off | size |
+full``); a step that fails verification — or raises during the actual
+orbax restore — is QUARANTINED (renamed ``corrupt-<step>``, never
+deleted) and restore walks back to the next older step until one loads.
+Multi-host: process 0 makes every step decision and broadcasts it (same
+protocol as ``_apply_epoch_override``), so hosts can't diverge onto
+different steps and deadlock the collectives. Steps written before the
+manifest existed carry nothing to verify against and stay restorable.
+``tools/fmckpt`` is the offline view of the same invariants.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Dict, Optional
+import re
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
 from fast_tffm_tpu.obs.trace import span
+from fast_tffm_tpu.utils.logging import get_logger
 from fast_tffm_tpu.utils.retry import RetryPolicy, retry_io
+
+# ckpt_verify knob values (config.py): "off" skips verification
+# entirely, "size" checks per-file byte counts against the manifest
+# (catches torn/truncated writes for the cost of one stat per file),
+# "full" additionally re-hashes every byte (catches silent bit rot; a
+# full pass over a config-#5 checkpoint reads the whole state once).
+CKPT_VERIFY_MODES = ("off", "size", "full")
+
+# Quarantined step dirs: ``corrupt-<step>`` (+ ``.k`` suffixes when a
+# step is quarantined more than once). Never auto-deleted — operators
+# reclaim the space explicitly with ``fmckpt gc``.
+QUARANTINE_PREFIX = "corrupt-"
+
+_MANIFEST_FORMAT = 1
+_HASH_CHUNK_BYTES = 1 << 20
+
+# The ONE sidecar-name pattern the run-time orphan pruning
+# (_prune_sidecars) and fmckpt's offline scan share — a sidecar rename
+# updated in one place only would make the offline tool delete files
+# the run still needs, or miss real orphans. Matches epoch overrides,
+# manifests, and torn manifest .tmp files (a killed writer's litter).
+SIDECAR_RE = re.compile(
+    r"(?:epoch_override-(\d+)|manifest-(\d+)\.json(?:\.tmp)?)")
+
+
+def sidecar_step(name: str) -> Optional[int]:
+    """The step a sidecar file name belongs to, or None for
+    non-sidecar names."""
+    m = SIDECAR_RE.fullmatch(name)
+    if not m:
+        return None
+    return int(m.group(1) or m.group(2))
+
+
+def manifest_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"manifest-{step}.json")
+
+
+def read_epoch_override(directory: str, step: int) -> Optional[int]:
+    """The step's epoch-correction sidecar value, or None
+    (missing/garbled/unreadable) — shared by restore's overlay and
+    fmckpt's listing so the two can't disagree on what restores."""
+    try:
+        with open(os.path.join(directory,
+                               f"epoch_override-{step}")) as fh:
+            return int(fh.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def list_step_dirs(directory: str) -> List[int]:
+    """Committed step numbers by DIRECT directory listing: orbax commits
+    a step by atomically renaming its tmp dir to the bare number, so a
+    digit-named directory IS a committed step (a killed writer leaves
+    only non-digit tmp names). Listed fresh on every call — quarantine
+    renames must be visible immediately, without trusting any manager's
+    cached step list."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    return sorted(int(n) for n in names
+                  if n.isdigit() and os.path.isdir(os.path.join(directory,
+                                                                n)))
+
+
+def _crc32_file(path: str) -> Tuple[int, int]:
+    """(crc32, byte count) of one file, streamed — the ONE hashing loop
+    the save-side manifest and the restore-side full verify share, so
+    the two can never diverge on chunking or masking. Both the reads
+    and zlib.crc32 on >4 KB buffers release the GIL, so the background
+    manifest writer doesn't stall the train loop."""
+    crc = 0
+    n = 0
+    with open(path, "rb") as fh:
+        while True:
+            chunk = fh.read(_HASH_CHUNK_BYTES)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            n += len(chunk)
+    return crc & 0xFFFFFFFF, n
+
+
+def compute_manifest(directory: str, step: int,
+                     payload: Optional[Dict[str, Any]] = None
+                     ) -> Dict[str, Any]:
+    """Walk a FINALIZED step directory into its integrity manifest:
+    per-file byte count + crc32 (sizes come from the bytes actually
+    read, so the size and the hash describe the same snapshot), plus
+    the caller's payload echo (step/epoch/vocab). Cost: one sequential
+    re-read of the step dir per committed save — the async-save path
+    runs it on a background thread (CheckpointState), so the train
+    loop never waits on the hash."""
+    step_dir = os.path.join(directory, str(step))
+    files: Dict[str, Dict[str, int]] = {}
+    for root, _dirs, names in os.walk(step_dir):
+        for name in sorted(names):
+            p = os.path.join(root, name)
+            rel = os.path.relpath(p, step_dir).replace(os.sep, "/")
+            crc, n = _crc32_file(p)
+            files[rel] = {"size": n, "crc32": crc}
+    man: Dict[str, Any] = {"format": _MANIFEST_FORMAT, "step": int(step),
+                           "files": files}
+    if payload:
+        man.update(payload)
+    return man
+
+
+def write_manifest(directory: str, step: int,
+                   manifest: Dict[str, Any]) -> str:
+    """Atomically-renamed manifest write (tmp + fsync + replace): a
+    manifest either exists complete or not at all — a torn manifest
+    must never brand an intact step corrupt."""
+    path = manifest_path(directory, step)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # A failed write must not litter: the .tmp is worthless (the
+        # rename never happened) and would otherwise accumulate across
+        # restarts. A hard kill still can leave one — the orphan scans
+        # (SIDECAR_RE) sweep those.
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def read_manifest(directory: str, step: int) -> Optional[Dict[str, Any]]:
+    """The step's manifest dict, or None when the step predates
+    manifests. A garbled manifest raises ValueError (json) — callers
+    decide whether that means corrupt (verify) or skip (ls)."""
+    try:
+        with open(manifest_path(directory, step), encoding="utf-8") as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+
+
+def verify_step_dir(directory: str, step: int,
+                    mode: str = "size") -> Optional[str]:
+    """Integrity verdict for one committed step: None when it passes —
+    or has no manifest to check against (pre-manifest checkpoints stay
+    restorable) — else a human-readable failure reason. ``size`` stats
+    every manifest-listed file; ``full`` additionally re-hashes them.
+    Extra files orbax adds later are ignored: the manifest pins what
+    the save wrote, not what may legitimately appear."""
+    if mode == "off":
+        return None
+    if mode not in CKPT_VERIFY_MODES:
+        raise ValueError(f"unknown ckpt_verify mode {mode!r} "
+                         f"(want one of {CKPT_VERIFY_MODES})")
+    try:
+        man = read_manifest(directory, step)
+    except (ValueError, OSError) as e:
+        # Garbled json AND unreadable file (EACCES, EIO, ESTALE) both
+        # become a VERDICT, never an exception: an escape here would
+        # crash restore on process 0 while its peers sit blocked in
+        # the decision broadcast — quarantine preserves the bytes, and
+        # the walk-back keeps the job alive.
+        return f"unreadable manifest: {e}"
+    if man is None:
+        return None
+    step_dir = os.path.join(directory, str(step))
+    if not os.path.isdir(step_dir):
+        return "step directory missing"
+    files = man.get("files") or {}
+    for rel in sorted(files):
+        p = os.path.join(step_dir, rel.replace("/", os.sep))
+        try:
+            size = os.path.getsize(p)
+        except OSError:
+            return f"missing file {rel}"
+        if int(size) != int(files[rel]["size"]):
+            return (f"size mismatch on {rel}: {size} bytes on disk != "
+                    f"{files[rel]['size']} in manifest")
+    if mode == "full":
+        for rel in sorted(files):
+            p = os.path.join(step_dir, rel.replace("/", os.sep))
+            try:
+                crc, _ = _crc32_file(p)
+            except OSError as e:
+                return f"unreadable file {rel}: {e}"
+            if crc != int(files[rel]["crc32"]):
+                return f"crc32 mismatch on {rel}"
+    return None
+
+
+def _tel():
+    from fast_tffm_tpu.obs.telemetry import active
+    return active()
 
 
 class CheckpointState:
@@ -40,9 +258,24 @@ class CheckpointState:
     (shape mismatches) propagate on the first raise."""
 
     def __init__(self, model_file: str, max_to_keep: int = 3,
-                 retry: Optional[RetryPolicy] = None):
+                 retry: Optional[RetryPolicy] = None,
+                 verify: str = "size"):
+        if verify not in CKPT_VERIFY_MODES:
+            raise ValueError(f"unknown ckpt_verify mode {verify!r} "
+                             f"(want one of {CKPT_VERIFY_MODES})")
         self.directory = os.path.abspath(model_file) + ".ckpt"
         self._retry = retry or RetryPolicy(retries=0)
+        self.verify = verify
+        # (step, epoch, vocab) of the newest ASYNC save whose manifest
+        # is still owed: the manifest can only describe a finalized
+        # (atomically renamed) step dir, so it's written at the next
+        # point the commit is certain — wait_until_finished, the next
+        # save (orbax back-pressures there anyway), or close.
+        self._pending_manifest: Optional[Tuple[int, int, int]] = None
+        # Background manifest writer (the periodic-save path): hashing
+        # a committed step is a full sequential re-read — at real table
+        # scale that must overlap the train loop, not block it.
+        self._manifest_thread: Optional[threading.Thread] = None
         os.makedirs(self.directory, exist_ok=True)
         self._mngr = ocp.CheckpointManager(
             self.directory,
@@ -72,6 +305,16 @@ class CheckpointState:
         # stall — the span shows the snapshot cost, `wait=True`
         # saves show the full write.
         with span("checkpoint/save", step=int(step), wait=wait):
+            # Settle the PREVIOUS async save's manifest before
+            # dispatching a new one: orbax back-pressures a new save on
+            # the in-flight write anyway, so the explicit wait here
+            # costs nothing extra and guarantees the manifest describes
+            # a finalized step dir. The hash itself runs on a
+            # background thread — it's a full re-read of the step dir,
+            # which must overlap the next save interval, not stall it.
+            if self._pending_manifest is not None:
+                self._mngr.wait_until_finished()
+                self._flush_pending_manifest(background=True)
             # Plain python ints for the scalar leaves: orbax's
             # StandardSave supported types are (int, float, np.ndarray,
             # jax.Array) — numpy SCALARS (np.int64) are rejected outright
@@ -90,12 +333,23 @@ class CheckpointState:
                 # below and silently skip the save.
                 self._mngr.save(step, args=ocp.args.StandardSave(payload),
                                 force=force)
+                self._pending_manifest = (int(step), int(epoch),
+                                          int(vocabulary_size))
                 # A FRESH save at this step carries authoritative metadata:
                 # drop any leftover same-step sidecar (a cleared-and-reused
                 # directory) and any sidecars orphaned by max_to_keep GC —
                 # CheckpointManager doesn't know about them.
                 if jax.process_index() == 0:
                     self._prune_sidecars(fresh_step=step)
+                    # Counted INSIDE the dispatch path and on process
+                    # 0 only (like the fallback counters — every
+                    # process's shard file merges by SUM in fmstat):
+                    # the same-step collision below is an orbax no-op,
+                    # and "checkpoint saves" means global saves that
+                    # wrote state.
+                    tel = _tel()
+                    if tel is not None:
+                        tel.count("checkpoint/saves")
             except ocp.checkpoint_manager.StepAlreadyExistsError:
                 # The final/preemption save can land on the same step as the
                 # last periodic save (save_steps divides the step count).
@@ -127,41 +381,88 @@ class CheckpointState:
                     os.replace(tmp, sc)
             if wait:
                 self._mngr.wait_until_finished()
+                self._flush_pending_manifest()
 
     def wait_until_finished(self) -> None:
         self._mngr.wait_until_finished()
+        self._flush_pending_manifest()
+
+    def _flush_pending_manifest(self, background: bool = False) -> None:
+        """Write the manifest for the last committed save. Call only
+        after ``wait_until_finished`` — the step dir must be finalized.
+        Process 0 only (one writer, like the epoch sidecar); a failed
+        manifest write downgrades the step to unverifiable (it stays
+        restorable, like a pre-manifest checkpoint) rather than failing
+        a save that already committed. ``background=True`` (the
+        periodic-save path) runs the hash on a daemon thread — any
+        earlier writer is joined first, so at most one manifest write
+        is ever in flight and they never reorder. Synchronous callers
+        (wait=True saves, wait_until_finished, close) join it too, so
+        after any of those the manifest is durably on disk."""
+        self._join_manifest_thread()
+        pend, self._pending_manifest = self._pending_manifest, None
+        if pend is None or jax.process_index() != 0:
+            return
+        if background:
+            t = threading.Thread(target=self._write_manifest_for,
+                                 args=pend, name="ckpt-manifest",
+                                 daemon=True)
+            self._manifest_thread = t
+            t.start()
+        else:
+            self._write_manifest_for(*pend)
+
+    def _join_manifest_thread(self) -> None:
+        t, self._manifest_thread = self._manifest_thread, None
+        if t is not None:
+            t.join()
+
+    def _write_manifest_for(self, step: int, epoch: int,
+                            vocab: int) -> None:
+        try:
+            man = compute_manifest(self.directory, step,
+                                   payload={"epoch": epoch,
+                                            "vocab": vocab})
+            write_manifest(self.directory, step, man)
+        except OSError:
+            get_logger().warning(
+                "manifest write for checkpoint step %d failed; the step "
+                "stays restorable but unverifiable", step, exc_info=True)
 
     def _epoch_sidecar(self, step: int) -> str:
         return os.path.join(self.directory, f"epoch_override-{step}")
 
     def _prune_sidecars(self, fresh_step: Optional[int] = None) -> None:
-        """Remove epoch sidecars that no longer correct anything.
+        """Remove epoch sidecars AND manifests that no longer describe
+        anything.
 
         Two legs with DIFFERENT failure contracts: removing the
-        fresh-step's stale sidecar is correctness-bearing (a survivor
-        would overlay the wrong epoch on the step just written —
-        cleared-and-reused dir case), so anything but "not there"
-        raises and fails the save loudly; the orphan scan for
-        GC-deleted steps is purely cosmetic (a leftover orphan costs
-        bytes and can never overlay: its step no longer restores), so
-        no flake in listdir/all_steps may fail an already-committed
-        save."""
-        import re
+        fresh-step's stale sidecar/manifest is correctness-bearing (a
+        surviving sidecar would overlay the wrong epoch on the step
+        just written, a surviving manifest would describe the OLD bytes
+        and brand the fresh step corrupt — cleared-and-reused dir
+        case), so anything but "not there" raises and fails the save
+        loudly; the orphan scan for GC-deleted steps is purely cosmetic
+        (a leftover orphan costs bytes and can never overlay or
+        verify: its step no longer restores), so no flake in
+        listdir/all_steps may fail an already-committed save."""
         if fresh_step is not None:
-            try:
-                os.remove(self._epoch_sidecar(fresh_step))
-            except FileNotFoundError:
-                pass  # the common case: nothing to correct
+            mp = manifest_path(self.directory, fresh_step)
+            for stale in (self._epoch_sidecar(fresh_step), mp,
+                          mp + ".tmp"):
+                try:
+                    os.remove(stale)
+                except FileNotFoundError:
+                    pass  # the common case: nothing to correct
         try:
             kept = set(self._mngr.all_steps())
             names = os.listdir(self.directory)
         except Exception:  # noqa: BLE001 - cosmetic scan only
             return
         for name in names:
-            m = re.fullmatch(r"epoch_override-(\d+)", name)
-            if not m:
+            s = sidecar_step(name)
+            if s is None:
                 continue
-            s = int(m.group(1))
             if s == fresh_step or s not in kept:
                 try:
                     os.remove(os.path.join(self.directory, name))
@@ -179,11 +480,11 @@ class CheckpointState:
             return restored
         override = -1
         if jax.process_index() == 0:
-            try:
-                with open(self._epoch_sidecar(step)) as fh:
-                    override = int(fh.read().strip())
-            except (FileNotFoundError, ValueError):
-                pass  # no/garbled sidecar -> step's own metadata stands
+            # Shared reader (fmckpt uses it too); any unreadable/
+            # garbled sidecar -> step's own metadata stands.
+            ov = read_epoch_override(self.directory, step)
+            if ov is not None:
+                override = ov
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
             override = int(multihost_utils.broadcast_one_to_all(
@@ -191,6 +492,103 @@ class CheckpointState:
         if override >= 0:
             restored["epoch"] = np.int64(override)
         return restored
+
+    # -- integrity: verify / quarantine / step decision -----------------
+
+    def verify_step(self, step: int,
+                    mode: Optional[str] = None) -> Optional[str]:
+        """Integrity verdict for one committed step against its
+        manifest: None when it passes (or carries no manifest —
+        pre-manifest checkpoints stay restorable), else a failure
+        reason. ``mode`` defaults to the instance's ``ckpt_verify``."""
+        return verify_step_dir(self.directory, step, mode or self.verify)
+
+    def quarantine_step(self, step: int, reason: str) -> str:
+        """Move a bad step out of the restore path WITHOUT deleting it:
+        the step dir is renamed ``corrupt-<step>`` and its
+        manifest/epoch sidecars move inside it (forensics travel with
+        the evidence; nothing can overlay or verify a quarantined
+        step). Emits the ``health: ckpt_fallback`` event + counters on
+        the active run telemetry. Returns the quarantine dir path.
+        Process 0 only in multi-host jobs — callers broadcast the
+        resulting step decision."""
+        src = os.path.join(self.directory, str(step))
+        dst = os.path.join(self.directory, f"{QUARANTINE_PREFIX}{step}")
+        k = 0
+        while os.path.exists(dst):
+            k += 1
+            dst = os.path.join(self.directory,
+                               f"{QUARANTINE_PREFIX}{step}.{k}")
+        os.rename(src, dst)
+        for name in (f"manifest-{step}.json", f"epoch_override-{step}"):
+            try:
+                os.replace(os.path.join(self.directory, name),
+                           os.path.join(dst, name))
+            except OSError:
+                pass  # sidecar absent (or unshared storage): forensics
+                # are best-effort, the rename above is the invariant
+        try:
+            with open(os.path.join(dst, "QUARANTINE"), "w",
+                      encoding="utf-8") as fh:
+                fh.write(f"step {step} quarantined at {time.time():.3f}: "
+                         f"{reason}\n")
+        except OSError:
+            pass
+        try:
+            # Drop the manager's cached step list: latest_step()/
+            # all_steps() must stop offering the quarantined step.
+            self._mngr.reload()
+        except Exception:  # noqa: BLE001 - cache refresh is advisory;
+            pass           # list_step_dirs() reads the directory fresh
+        from fast_tffm_tpu.obs.health import emit_ckpt_fallback
+        emit_ckpt_fallback(step, reason, dst)
+        get_logger().warning(
+            "checkpoint step %d failed integrity (%s); quarantined to %s "
+            "— falling back to an older step", step, reason, dst)
+        return dst
+
+    def _broadcast_int(self, value: int) -> int:
+        """Process 0's value on every process (the same broadcast
+        protocol as ``_apply_epoch_override``); identity when
+        single-process. Every step decision goes through this so
+        multi-host processes can't diverge onto different steps and
+        deadlock the collectives."""
+        if jax.process_count() <= 1:
+            return int(value)
+        from jax.experimental import multihost_utils
+        return int(multihost_utils.broadcast_one_to_all(np.int64(value)))
+
+    def _all_agree(self, flag: bool) -> bool:
+        """True only when EVERY process reports ``flag`` true (tiny
+        allgather; identity single-process). The restore walk-back
+        branches on restore success/failure — a per-process local
+        condition (one host's shard read can fail transiently while
+        the others succeed), so without this agreement the processes
+        would take different branches of the broadcast protocol and
+        pair mismatched collectives — the exact deadlock the broadcast
+        design exists to prevent."""
+        if jax.process_count() <= 1:
+            return bool(flag)
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([bool(flag)]))
+        return bool(np.asarray(flags).all())
+
+    def _pick_intact_step(self) -> Tuple[int, int]:
+        """Newest step that passes verification, quarantining every
+        newer step that doesn't. Returns (step, n_quarantined), step -1
+        when no step survives. Process 0 only — callers broadcast."""
+        n = 0
+        while True:
+            steps = list_step_dirs(self.directory)
+            if not steps:
+                return -1, n
+            s = steps[-1]
+            reason = self.verify_step(s)
+            if reason is None:
+                return s, n
+            self.quarantine_step(s, reason)
+            n += 1
 
     def restore_partial(self, template: Dict[str, Any],
                         step: Optional[int] = None
@@ -201,12 +599,18 @@ class CheckpointState:
         scale the accumulator is half the state, and materializing it
         just to drop it doubles peak host RSS. Uses a read-only
         PyTree-handler manager (StandardSave's on-disk format is the
-        PyTree format; partial restore is a PyTreeRestore feature)."""
+        PyTree format; partial restore is a PyTreeRestore feature).
+        Latest-step selection goes through the same verify + quarantine
+        + broadcast decision as restore()."""
         with span("checkpoint/restore", partial=True):
-            self._mngr.wait_until_finished()
-            s = step if step is not None else self.latest_step()
+            self.wait_until_finished()
+            s = step
             if s is None:
-                return None
+                cand = (self._pick_intact_step()[0]
+                        if jax.process_index() == 0 else -1)
+                s = self._broadcast_int(cand)
+                if s < 0:
+                    return None
             reader = ocp.CheckpointManager(
                 self.directory,
                 item_handlers=ocp.PyTreeCheckpointHandler())
@@ -233,26 +637,129 @@ class CheckpointState:
         """Returns {"table", "acc", "step"} as host arrays, or None if no
         checkpoint exists yet (fresh start). ``template`` is an abstract
         pytree (jax.ShapeDtypeStruct leaves) matching what was saved;
-        required by orbax to reconstruct arrays."""
+        required by orbax to reconstruct arrays.
+
+        With ``step=None`` the newest INTACT checkpoint wins: every
+        candidate is verified against its manifest before orbax touches
+        it, and a candidate that fails verification — or raises during
+        the restore itself — is quarantined (``corrupt-<step>``, never
+        deleted) while restore walks back to the next older step. An
+        EXPLICIT step is verified but never quarantined or walked past:
+        the caller asked for those exact bytes."""
         with span("checkpoint/restore"):
-            self._mngr.wait_until_finished()  # in-flight async save first
-            s = step if step is not None else self.latest_step()
-            if s is None:
+            self.wait_until_finished()  # in-flight async save first
+            if step is not None:
+                reason = self.verify_step(step)
+                if reason is not None:
+                    raise ValueError(
+                        f"checkpoint step {step} at {self.directory} "
+                        f"failed integrity verification: {reason}. An "
+                        "explicitly requested step is never quarantined "
+                        "automatically — inspect it with `python -m "
+                        "tools.fmckpt verify`.")
+                restored, err = self._attempt_restore(step, template)
+                if err is not None:
+                    self._raise_restore_error(step, err)
+                return self._apply_epoch_override(step, restored)
+            return self._restore_newest_intact(template)
+
+    def _restore_newest_intact(self, template
+                               ) -> Optional[Dict[str, Any]]:
+        """The self-healing walk-back (class docstring): process 0
+        picks + verifies + quarantines, every decision is broadcast,
+        all processes restore the agreed step together."""
+        proc0 = jax.process_index() == 0
+        quarantined = 0
+        first_err: Optional[Tuple[int, BaseException]] = None
+        while True:
+            cand = -1
+            if proc0:
+                cand, nq = self._pick_intact_step()
+                quarantined += nq
+            cand = self._broadcast_int(cand)
+            if cand < 0:
+                if first_err is not None:
+                    # Every remaining candidate failed to LOAD (the
+                    # verify-failures are already quarantined): surface
+                    # the original, newest-step error — on a config
+                    # mismatch that is the diagnosis for every step.
+                    self._raise_restore_error(*first_err)
+                had_quarantine = self._broadcast_int(
+                    1 if quarantined else 0)
+                if had_quarantine:
+                    # Never silently convert "all checkpoints failed
+                    # integrity" into a fresh start: a fresh run would
+                    # quietly retrain from zero on top of hours of
+                    # quarantined-but-recoverable optimizer state.
+                    raise ValueError(
+                        f"every checkpoint step at {self.directory} "
+                        "failed integrity verification and was "
+                        "quarantined (corrupt-*). Inspect with `python "
+                        "-m tools.fmckpt ls` / `verify`; rename an "
+                        "intact corrupt-<step> back to <step> to "
+                        "recover it, or point model_file elsewhere to "
+                        "start fresh.")
                 return None
+            restored, err = self._attempt_restore(cand, template)
+            # Success/failure is a PER-PROCESS condition (one host's
+            # shard read can fail while the others succeed): agree on
+            # it before branching, or the processes would pair
+            # mismatched collectives and deadlock.
+            if self._all_agree(err is None):
+                if quarantined:
+                    tel = _tel()
+                    if tel is not None:  # process 0 only: quarantined
+                        # is always 0 elsewhere, so the count is global
+                        tel.count("checkpoint/fallbacks")
+                return self._apply_epoch_override(cand, restored)
+            if err is None:
+                # This process succeeded but a peer didn't: walk back
+                # with everyone (the restored tree may hold
+                # non-addressable shards of a step the job as a whole
+                # cannot load).
+                err = RuntimeError(
+                    f"restore of step {cand} failed on another process")
+            if first_err is None:
+                first_err = (cand, err)
+            # Walk past a restore-time failure only when an OLDER step
+            # remains: quarantining the last loadable-looking step on
+            # (say) a config mismatch would turn a loud, actionable
+            # error into a silent fresh start.
+            has_more = 0
+            if proc0 and any(t != cand
+                             for t in list_step_dirs(self.directory)):
+                has_more = 1
+            has_more = self._broadcast_int(has_more)
+            if not has_more:
+                self._raise_restore_error(cand, err)
+            if proc0:
+                self.quarantine_step(
+                    cand, f"restore failed: {type(err).__name__}: {err}")
+                quarantined += 1
+
+    def _attempt_restore(self, s: int, template
+                         ) -> Tuple[Optional[Dict[str, Any]],
+                                    Optional[BaseException]]:
+        """One orbax restore attempt at step ``s`` (transient-IO
+        retries + legacy-epoch tolerance included). Returns
+        (restored, None) or (None, error) — the fallback loop owns
+        deciding what an error means. OSError is caught alongside the
+        semantic classes: after retry_io gives up, a persistently
+        unreadable file IS the torn-write signature for steps too old
+        to carry a manifest."""
+        try:
             if template is None:
-                return self._apply_epoch_override(
-                    s, retry_io(self._mngr.restore, s,
+                return retry_io(self._mngr.restore, s,
                                 policy=self._retry,
-                                op="checkpoint_restore"))
-            restored, err = _restore_tolerating_legacy_epoch(
+                                op="checkpoint_restore"), None
+            return _restore_tolerating_legacy_epoch(
                 template,
                 lambda t: retry_io(
                     self._mngr.restore, s,
                     args=ocp.args.StandardRestore(t),
                     policy=self._retry, op="checkpoint_restore"))
-            if err is not None:
-                self._raise_restore_error(s, err)
-            return self._apply_epoch_override(s, restored)
+        except (ValueError, KeyError, OSError) as e:
+            return None, e
 
     def _raise_restore_error(self, s, e) -> None:
         # Orbax surfaces config-mismatch as a shape ValueError (whose
@@ -270,11 +777,19 @@ class CheckpointState:
             "storage layout — fix the config or point model_file at "
             "the matching checkpoint. If the config is right, this "
             "step directory may be corrupt/partially written (killed "
-            "save): try an earlier step or delete the bad step dir. "
-            f"Underlying error: {e}") from e
+            "save): newer bad steps are quarantined automatically as "
+            "corrupt-<step>; inspect the directory with `python -m "
+            f"tools.fmckpt ls`. Underlying error: {e}") from e
 
     def close(self) -> None:
-        self._mngr.close()
+        """Settle any in-flight async save (and its owed manifest)
+        before releasing the manager — close is the last point a
+        crashed-out driver can make the newest step verifiable."""
+        try:
+            self._mngr.wait_until_finished()
+            self._flush_pending_manifest()
+        finally:
+            self._mngr.close()
 
 
 def _restore_tolerating_legacy_epoch(template, do_restore):

@@ -162,6 +162,17 @@ class FmConfig:
     # count io/retries in the metrics stream. 0 = fail fast.
     io_retries: int = 2
     io_backoff_seconds: float = 0.1
+    # Checkpoint integrity verification before restore (checkpoint.py;
+    # README "Checkpoint integrity & fallback"): "size" (default)
+    # checks per-file byte counts against the save-time
+    # manifest-<step>.json (catches torn/truncated writes for one stat
+    # per file), "full" additionally re-hashes every byte (crc32;
+    # catches silent bit rot at the cost of reading the whole
+    # checkpoint once), "off" skips verification. A step that fails —
+    # or raises during restore — is quarantined (renamed
+    # corrupt-<step>, never deleted) and restore falls back to the
+    # newest older intact step. Inspect with: python -m tools.fmckpt
+    ckpt_verify: str = "size"       # "off" | "size" | "full"
 
     # --- [Predict] ---------------------------------------------------------
     predict_files: Tuple[str, ...] = ()
@@ -173,6 +184,14 @@ class FmConfig:
     # coordinator/process env (parallel/distributed.py).
     ps_hosts: Tuple[str, ...] = ()
     worker_hosts: Tuple[str, ...] = ()
+    # Cluster bring-up budget (parallel/distributed.py): total seconds
+    # a worker keeps retrying to reach the jax.distributed coordinator
+    # before raising (naming the coordinator address and this process).
+    # Generous by default: the coordinator pod/task often boots LAST,
+    # and a worker that gives up in seconds turns a routine staggered
+    # start into a failed job — but a worker must never hang forever
+    # on a coordinator that will never come up.
+    cluster_connect_timeout_seconds: float = 300.0
 
     def __post_init__(self):
         if self.order < 2:
@@ -272,6 +291,14 @@ class FmConfig:
             raise ValueError(
                 f"io_backoff_seconds must be >= 0, got "
                 f"{self.io_backoff_seconds}")
+        if self.ckpt_verify not in ("off", "size", "full"):
+            raise ValueError(
+                f"unknown ckpt_verify {self.ckpt_verify!r} "
+                "(want off | size | full)")
+        if self.cluster_connect_timeout_seconds <= 0:
+            raise ValueError(
+                f"cluster_connect_timeout_seconds must be > 0, got "
+                f"{self.cluster_connect_timeout_seconds}")
         if self.weight_files and not self.train_files:
             # Mirror of the validation_weight_files check above: a
             # sidecar list with nothing to pair against is always a
@@ -373,6 +400,7 @@ _TRAIN_KEYS = {
     "max_bad_fraction": float,
     "io_retries": int,
     "io_backoff_seconds": float,
+    "ckpt_verify": str,
 }
 _PREDICT_KEYS = {
     "predict_files": _split_files,
@@ -381,6 +409,7 @@ _PREDICT_KEYS = {
 _CLUSTER_KEYS = {
     "ps_hosts": _split_files,
     "worker_hosts": _split_files,
+    "cluster_connect_timeout_seconds": float,
 }
 
 

@@ -196,7 +196,8 @@ class HostOffloadLookup:
                                          checkpoint_template)
         from fast_tffm_tpu.utils.retry import RetryPolicy
         ckpt = CheckpointState(cfg.model_file,
-                               retry=RetryPolicy.from_config(cfg))
+                               retry=RetryPolicy.from_config(cfg),
+                               verify=getattr(cfg, "ckpt_verify", "size"))
         template = checkpoint_template(cfg, host=True)
         if with_acc:
             restored = ckpt.restore(template=template)

@@ -164,6 +164,13 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
         # skipped under bad_line_policy, and transient-IO retries paid.
         "bad_lines": c.get("pipeline/bad_lines", 0),
         "io_retries": c.get("io/retries", 0),
+        # State-plane accounting (README "Checkpoint integrity &
+        # fallback"): saves committed, restores that fell back past a
+        # bad step, and step dirs quarantined (corrupt-<step>).
+        "checkpoint_saves": c.get("checkpoint/saves", 0),
+        "checkpoint_fallbacks": c.get("checkpoint/fallbacks", 0),
+        "checkpoint_quarantined": c.get("checkpoint/quarantined_steps",
+                                        0),
     }
 
     # Predict-path stats (a predict stream has no train loop at all;
@@ -249,13 +256,20 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
     because a crash ends the run while a survived stall merely delayed
     it, and a preemption (train's SIGTERM/SIGINT save-and-exit path
     emits ``health: preempted``) is a CLEAN exit that must not read as
-    a crash — the run saved, and a restart resumes it. A stream that
-    never wrote its run_end gets flagged in the detail either way (a
-    hard-killed run writes no crash event; a live run hasn't finished —
-    the reader knows which one it is holding)."""
+    a crash — the run saved, and a restart resumes it. A run that
+    RECOVERED from a bad checkpoint (``health: ckpt_fallback``:
+    restore quarantined the newest step and fell back) reads as
+    ``OK (ckpt fallback xN)`` — healed, but never silently green: the
+    operator should know state was lost and a corrupt-<step> dir is
+    waiting for fmckpt. A stream that never wrote its run_end gets
+    flagged in the detail either way (a hard-killed run writes no
+    crash event; a live run hasn't finished — the reader knows which
+    one it is holding)."""
     crashes = summary.get("crash_events") or []
     health = summary.get("health_events") or []
     stalls = [h for h in health if h.get("status") == "stalled"]
+    fallbacks = [h for h in health
+                 if h.get("status") == "ckpt_fallback"]
     recoveries = [h for h in health if h.get("status") == "recovered"]
     nonfin = [h for h in health
               if str(h.get("status", "")).startswith("nonfinite")]
@@ -300,6 +314,17 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                      f"{stalls[0].get('stacks_file', '?')}"] + notes)}
     if unclosed:
         return {"verdict": "CRASHED", "detail": notes[0]}
+    if fallbacks:
+        steps = ", ".join(str(h.get("step", "?")) for h in fallbacks)
+        quars = [h.get("quarantined") for h in fallbacks
+                 if h.get("quarantined")]
+        where = f"; quarantined: {quars[-1]}" if quars else ""
+        return {"verdict": f"OK (ckpt fallback x{len(fallbacks)})",
+                "detail": "; ".join(
+                    [f"restore quarantined bad checkpoint step(s) "
+                     f"{steps} and fell back to an older step — the "
+                     f"run then completed cleanly{where}; reclaim "
+                     "space with `python -m tools.fmckpt gc`"] + notes)}
     return {"verdict": "OK", "detail": "no health/crash events; "
             "run_end present"}
 
@@ -368,6 +393,10 @@ def render(summary: Dict[str, Any]) -> str:
         ("parse errors", att["parse_errors"]),
         ("bad lines skipped", att["bad_lines"]),
         ("io retries", att["io_retries"]),
+        ("checkpoint saves", att["checkpoint_saves"]),
+        ("ckpt fallbacks / quarantined steps",
+         f"{_fmt(att['checkpoint_fallbacks'])} / "
+         f"{_fmt(att['checkpoint_quarantined'])}"),
     ]
     if att["predict_examples"]:
         rows += [

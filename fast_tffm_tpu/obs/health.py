@@ -170,6 +170,27 @@ class Watchdog:
             pass
 
 
+def emit_ckpt_fallback(step: int, reason: str, quarantined: str) -> None:
+    """The state-plane fallback event (checkpoint.quarantine_step): a
+    ``health: ckpt_fallback`` record + ``checkpoint/quarantined_steps``
+    counter on the active run telemetry, flushed straight to disk — the
+    very next thing the run does is retry an OLDER checkpoint, and if
+    that also fails the evidence must already be on disk. No-op without
+    an active run (offline tools like fmckpt verify without emitting)."""
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is None:
+        return
+    tel.count("checkpoint/quarantined_steps")
+    tel.sink.emit("health", {
+        "status": "ckpt_fallback",
+        "step": int(step),
+        "reason": str(reason)[:300],
+        "quarantined": quarantined,
+    })
+    tel.sink.flush()
+
+
 def format_crash(exc: BaseException, limit_chars: int = 8000) -> str:
     """The traceback text a crash event carries, tail-truncated (the
     frames nearest the raise are the forensic payload)."""

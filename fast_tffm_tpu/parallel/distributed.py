@@ -17,9 +17,17 @@ a job that listed N ps hosts simply doesn't start them.
 
 from __future__ import annotations
 
-from typing import Tuple
+import time
+from typing import Callable, Tuple
 
 from fast_tffm_tpu.config import FmConfig
+
+# Per-attempt cap on the coordinator handshake: the total budget
+# (cluster_connect_timeout_seconds) is spent in bounded slices with a
+# short breather between them, so one wedged TCP connect can't eat the
+# whole budget and the worker's log shows it is still trying.
+CONNECT_ATTEMPT_CAP_SECONDS = 60.0
+CONNECT_RETRY_SLEEP_SECONDS = 2.0
 
 
 def coordinator_address(cfg: FmConfig) -> str:
@@ -32,6 +40,61 @@ def coordinator_address(cfg: FmConfig) -> str:
         name, port = host.rsplit(":", 1)
         return f"{name}:{int(port) + 1000}"
     return f"{host}:8476"
+
+
+def initialize_with_retry(initialize: Callable[..., None], address: str,
+                          num_processes: int, process_id: int,
+                          timeout_seconds: float,
+                          sleep: Callable[[float], None] = time.sleep,
+                          clock: Callable[[], float] = time.monotonic
+                          ) -> int:
+    """Drive ``initialize`` (jax.distributed.initialize-shaped) in a
+    bounded retry loop until it succeeds or ``timeout_seconds`` of
+    total budget is spent, then raise naming the coordinator address
+    and which process failed to join — the un-hardened call hangs
+    workers forever on a coordinator that is still booting (the common
+    staggered bring-up) or never coming (the failure an operator must
+    see, not infer from silence). Each attempt gets jax's own
+    ``initialization_timeout`` capped at CONNECT_ATTEMPT_CAP_SECONDS
+    and at the remaining budget. ``sleep``/``clock`` are injectable so
+    tests pin the budget math without real waits. Returns the number
+    of attempts made (for logging/tests)."""
+    deadline = clock() + timeout_seconds
+    attempts = 0
+    last_error: Exception = None  # type: ignore[assignment]
+    while True:
+        remaining = deadline - clock()
+        if remaining <= 0:
+            raise RuntimeError(
+                f"process {process_id} failed to join the "
+                f"jax.distributed cluster: coordinator {address} did "
+                f"not accept the connection within "
+                f"cluster_connect_timeout_seconds={timeout_seconds:g}s "
+                f"({attempts} attempt(s)). Is the coordinator process "
+                "(worker 0) up, and its port (worker_hosts[0] port + "
+                f"1000) reachable from this host? Last error: "
+                f"{last_error}") from last_error
+        attempts += 1
+        try:
+            initialize(coordinator_address=address,
+                       num_processes=num_processes,
+                       process_id=process_id,
+                       initialization_timeout=max(1, int(min(
+                           remaining, CONNECT_ATTEMPT_CAP_SECONDS))))
+            return attempts
+        except Exception as e:  # jax surfaces an unreachable
+            # coordinator as RuntimeError (grpc DEADLINE_EXCEEDED /
+            # UNAVAILABLE) — class varies by jax version, so retry on
+            # any failure while budget remains; a genuinely fatal
+            # misconfiguration exhausts the budget and raises with the
+            # last underlying error attached.
+            last_error = e
+            if clock() + CONNECT_RETRY_SLEEP_SECONDS >= deadline:
+                # No room for another attempt: fall through to the
+                # deadline raise on the next loop iteration.
+                sleep(max(0.0, deadline - clock()))
+            else:
+                sleep(CONNECT_RETRY_SLEEP_SECONDS)
 
 
 def init_from_cluster(cfg: FmConfig, job_name: str,
@@ -74,10 +137,29 @@ def init_from_cluster(cfg: FmConfig, job_name: str,
     # this setting only affects the CPU client, e.g. the localhost
     # smoke-cluster test, SURVEY §4).
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address(cfg),
+
+    def _initialize(**kw):
+        try:
+            jax.distributed.initialize(**kw)
+        except Exception:
+            # A failed connect leaves the half-built client in
+            # jax.distributed's global state (the client is registered
+            # BEFORE connect()), and a bare re-initialize would then
+            # raise 'should only be called once' instead of retrying.
+            # Tear the partial state down so the next attempt is clean.
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            raise
+
+    initialize_with_retry(
+        _initialize,
+        address=coordinator_address(cfg),
         num_processes=len(hosts),
-        process_id=task_index)
+        process_id=task_index,
+        timeout_seconds=getattr(cfg, "cluster_connect_timeout_seconds",
+                                300.0))
     if jax.process_count() != len(hosts):
         raise RuntimeError(
             "jax.distributed did not federate the cluster: expected "

@@ -45,7 +45,8 @@ def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
     from fast_tffm_tpu.train import checkpoint_template
     from fast_tffm_tpu.utils.retry import RetryPolicy
     ckpt = CheckpointState(cfg.model_file,
-                           retry=RetryPolicy.from_config(cfg))
+                           retry=RetryPolicy.from_config(cfg),
+                           verify=getattr(cfg, "ckpt_verify", "size"))
     restored = ckpt.restore(template=checkpoint_template(cfg, mesh))
     ckpt.close()
     if restored is None:

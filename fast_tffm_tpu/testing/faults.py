@@ -128,15 +128,21 @@ def preempt_after_steps(n: int,
 
 
 def truncate_checkpoint(model_file: str, seed: int = 0,
-                        keep_bytes: int = 8) -> Optional[str]:
+                        keep_bytes: int = 8,
+                        step: Optional[int] = None) -> Optional[str]:
     """Simulate a torn checkpoint write: pick (seeded) one of the
-    largest files under the LATEST step directory of
-    ``<model_file>.ckpt/`` and truncate it to ``keep_bytes``. Returns
-    the truncated path, or None when no step directory exists."""
+    largest files under the LATEST step directory (or an explicit
+    ``step``) of ``<model_file>.ckpt/`` and truncate it to
+    ``keep_bytes``. Returns the truncated path, or None when no step
+    directory exists. The save-time ``manifest-<step>.json`` sidecar is
+    left untouched — exactly the torn-write shape ``ckpt_verify`` must
+    catch (sizes on disk no longer match the manifest)."""
     directory = os.path.abspath(model_file) + ".ckpt"
     if not os.path.isdir(directory):
         return None
     steps = [d for d in os.listdir(directory) if d.isdigit()]
+    if step is not None:
+        steps = [d for d in steps if int(d) == step]
     if not steps:
         return None
     step_dir = os.path.join(directory, max(steps, key=int))

@@ -248,6 +248,7 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     profiling = False
     prev_handlers = {}
     global_step = 0
+    ckpt = None
     # One run-scoped tracker (None under bad_line_policy = error): the
     # max_bad_fraction breaker and the quarantine dedupe must see the
     # WHOLE run, not one epoch's iterator (data/badlines.py).
@@ -275,7 +276,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 cfg, cfg.validation_files)
 
         ckpt = CheckpointState(cfg.model_file,
-                               retry=RetryPolicy.from_config(cfg))
+                               retry=RetryPolicy.from_config(cfg),
+                               verify=getattr(cfg, "ckpt_verify", "size"))
         global_step = 0
         restored = ckpt.restore(
             template=checkpoint_template(cfg, mesh, host=offload))
@@ -802,6 +804,18 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
         raise
     finally:
         try:
+            # Checkpoint lifecycle on ALL exit paths: an exception (or
+            # preemption) between the last periodic save and the normal
+            # close must not leave an async save in flight — the
+            # process would exit mid-write and tear the newest step.
+            # close() waits for the in-flight write, settles the owed
+            # integrity manifest, and releases the manager; isolated so
+            # a failed close can't starve the sink drains below.
+            if ckpt is not None:
+                try:
+                    ckpt.close()
+                except Exception:
+                    logger.exception("checkpoint close failed")
             # Sink lifecycle on error paths: a crash mid-epoch must not
             # drop everything buffered since the last flush — the log
             # buffer, the TensorBoard scalars, and the metrics sink all
@@ -844,7 +858,6 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 signal.signal(sig, h)
     logger.info("training done: %d steps, final loss %.6f, %.0f examples/sec",
                 global_step, loss_val, timer.total_examples_per_sec)
-    ckpt.close()
     if offload:
         # The logical table as host numpy (the offload analogue of the
         # device table return; dead ckpt-alignment tail sliced off).

@@ -1,0 +1,104 @@
+"""Cluster bring-up hardening (ISSUE 5 satellite): the coordinator
+handshake runs in a bounded retry loop — a coordinator that is still
+booting doesn't hang workers forever, and exhaustion names the
+coordinator address and the process that failed to join. Pure-logic
+tests: the initialize callable, sleep, and clock are injected."""
+
+import pytest
+
+from fast_tffm_tpu.parallel.distributed import (
+    CONNECT_ATTEMPT_CAP_SECONDS, CONNECT_RETRY_SLEEP_SECONDS,
+    initialize_with_retry)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def test_succeeds_after_transient_failures():
+    """The staggered-start case: the coordinator comes up on the third
+    attempt; the worker joins instead of dying on the first refusal."""
+    clock = FakeClock()
+    calls = []
+
+    def init(**kw):
+        calls.append(kw)
+        if len(calls) < 3:
+            raise RuntimeError("UNAVAILABLE: failed to connect")
+
+    attempts = initialize_with_retry(
+        init, address="head:9476", num_processes=4, process_id=2,
+        timeout_seconds=600.0, sleep=clock.sleep, clock=clock)
+    assert attempts == 3
+    assert len(calls) == 3
+    # every attempt targets the same cluster identity
+    for kw in calls:
+        assert kw["coordinator_address"] == "head:9476"
+        assert kw["num_processes"] == 4
+        assert kw["process_id"] == 2
+    # per-attempt handshake budget is capped, not the whole budget
+    assert calls[0]["initialization_timeout"] == int(
+        CONNECT_ATTEMPT_CAP_SECONDS)
+    assert clock.sleeps == [CONNECT_RETRY_SLEEP_SECONDS] * 2
+
+
+def test_exhaustion_names_coordinator_and_process():
+    clock = FakeClock()
+
+    def init(**kw):
+        raise RuntimeError("DEADLINE_EXCEEDED: deadline exceeded")
+
+    with pytest.raises(RuntimeError) as ei:
+        initialize_with_retry(
+            init, address="coord.example:8476", num_processes=8,
+            process_id=5, timeout_seconds=10.0, sleep=clock.sleep,
+            clock=clock)
+    msg = str(ei.value)
+    assert "coord.example:8476" in msg
+    assert "process 5" in msg
+    assert "cluster_connect_timeout_seconds=10" in msg
+    assert "DEADLINE_EXCEEDED" in msg  # the underlying cause survives
+    assert ei.value.__cause__ is not None
+
+
+def test_attempt_timeout_shrinks_to_remaining_budget():
+    """The last attempt's jax-level timeout must not overrun the total
+    budget: with 90 s left of a fresh 90 s budget, the first attempt
+    gets 60 (the cap); after it fails at t=70, the next gets ~18."""
+    clock = FakeClock()
+    calls = []
+
+    def init(**kw):
+        calls.append(kw["initialization_timeout"])
+        if len(calls) == 1:
+            clock.t += 70.0  # a slow hang inside the handshake
+            raise RuntimeError("UNAVAILABLE")
+
+    initialize_with_retry(init, address="h:1", num_processes=2,
+                          process_id=1, timeout_seconds=90.0,
+                          sleep=clock.sleep, clock=clock)
+    assert calls[0] == int(CONNECT_ATTEMPT_CAP_SECONDS)
+    assert calls[1] <= 90 - 70  # bounded by what's left
+
+
+def test_zero_budget_never_calls_initialize():
+    clock = FakeClock()
+    clock.t = 5.0
+
+    def init(**kw):
+        raise AssertionError("must not be called")
+
+    with pytest.raises(RuntimeError, match="failed to join"):
+        initialize_with_retry(init, address="h:1", num_processes=2,
+                              process_id=0, timeout_seconds=0.0,
+                              sleep=clock.sleep,
+                              clock=lambda: clock.t + 1.0)

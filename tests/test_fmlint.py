@@ -261,3 +261,70 @@ def test_r004_respects_pragma(tmp_path):
                 pass
     """)
     assert run_file(path) == []
+
+
+def _any_file(tmp_path, body, name="cleanup.py"):
+    """R005 applies to every linted module, not just hot ones."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_r005_flags_rmtree_on_ckpt_path(tmp_path):
+    """ISSUE 5 satellite: quarantine-not-delete is the state-plane
+    invariant — direct deletion of checkpoint state outside
+    checkpoint.py is a finding."""
+    path = _any_file(tmp_path, """\
+        import shutil
+        def clean(ckpt_dir):
+            shutil.rmtree(ckpt_dir)
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R005"]
+    assert "quarantine" in found[0].message
+
+
+def test_r005_flags_os_remove_on_ckpt_literal(tmp_path):
+    path = _any_file(tmp_path, """\
+        import os
+        def clean(model):
+            os.remove(model + ".ckpt/manifest-3.json")
+    """)
+    assert [f.rule for f in run_file(path)] == ["R005"]
+
+
+def test_r005_flags_step_dir_unlink(tmp_path):
+    path = _any_file(tmp_path, """\
+        import os
+        def clean(step_dir):
+            os.unlink(step_dir)
+    """)
+    assert [f.rule for f in run_file(path)] == ["R005"]
+
+
+def test_r005_allows_checkpoint_py_itself(tmp_path):
+    path = _any_file(tmp_path, """\
+        import shutil
+        def clean(ckpt_dir):
+            shutil.rmtree(ckpt_dir)
+    """, name="checkpoint.py")
+    assert run_file(path) == []
+
+
+def test_r005_allows_non_ckpt_deletes(tmp_path):
+    path = _any_file(tmp_path, """\
+        import os
+        def clean(part_file):
+            os.remove(part_file)
+    """)
+    assert run_file(path) == []
+
+
+def test_r005_respects_pragma(tmp_path):
+    path = _any_file(tmp_path, """\
+        import shutil
+        def gc(ckpt_dir):
+            # fmlint: disable=R005 -- sanctioned operator gc path
+            shutil.rmtree(ckpt_dir)
+    """)
+    assert run_file(path) == []

@@ -26,6 +26,20 @@ asserts the documented recovery behavior:
                       cleanly, ``fmstat`` reports PREEMPTED (not
                       CRASHED); a restart resumes the interrupted
                       epoch schedule and finishes OK.
+- ``truncate-latest`` the newest checkpoint step is torn (truncated
+                      array file) → with ``ckpt_verify = size`` the
+                      restart quarantines it (``corrupt-<step>``,
+                      never deleted), resumes from the previous step
+                      with the correct epoch, emits
+                      ``health: ckpt_fallback``, ``fmstat`` reports
+                      ``OK (ckpt fallback x1)`` — and trains to the
+                      SAME final table as a clean resume from that
+                      step.
+- ``kill-async-save`` SIGKILL a real training child mid-async-save
+                      burst → the restart restores a committed step
+                      cleanly (verified restore; orbax's atomic commit
+                      plus the manifest check hide/catch any torn
+                      state) and completes OK.
 
 The scenario functions are plain callables (workdir in, asserts
 inside) so tests/test_chaos.py runs the same soaks under tier-1; the
@@ -222,12 +236,171 @@ def scenario_preempt_resume(workdir: str, seed: int = 0) -> str:
             f"{cfg.epoch_num}/{cfg.epoch_num} epochs")
 
 
+def scenario_truncate_latest(workdir: str, seed: int = 0) -> str:
+    """Torn newest checkpoint (the acceptance scenario): with
+    ``ckpt_verify = size`` the restart quarantines the truncated step,
+    resumes from the previous step with the correct epoch, reports the
+    fallback in fmstat — and trains to the SAME final table as a
+    control twin that cleanly resumed from that previous step (the
+    old by-hand remedy), so the healed run lost nothing but the torn
+    step."""
+    import shutil
+    from fast_tffm_tpu.checkpoint import (CheckpointState,
+                                          QUARANTINE_PREFIX,
+                                          list_step_dirs, manifest_path)
+    from fast_tffm_tpu.testing.faults import truncate_checkpoint
+    from fast_tffm_tpu.train import checkpoint_template, train
+    workdir = os.path.abspath(workdir)
+    data = os.path.join(workdir, "train_trunc.txt")
+    _write_corpus(data, 400, seed)
+    # Run 1: 400/32 -> 13 steps; periodic saves at 5 and 10, final 13.
+    cfg = _cfg(workdir, data, save_steps=5)
+    train(cfg)
+    ckpt_dir = cfg.model_file + ".ckpt"
+    steps = list_step_dirs(ckpt_dir)
+    assert steps[-2:] == [10, 13], steps
+    # Control twin BEFORE the fault: same run-1 state, newest step
+    # removed CLEANLY (the manual remedy this PR automates), so its
+    # resume starts from the same step the fallback should pick.
+    control = os.path.join(workdir, "control")
+    os.makedirs(control, exist_ok=True)
+    shutil.copytree(os.path.join(workdir, "model"),
+                    os.path.join(control, "model"))
+    control_cfg = _cfg(control, data, epoch_num=2)
+    control_ckpt_dir = control_cfg.model_file + ".ckpt"
+    # fmlint: disable=R005 -- chaos control twin simulates the old
+    # BY-HAND remedy (operator deletes the bad step) outside any run
+    shutil.rmtree(os.path.join(control_ckpt_dir, "13"))
+    for sidecar in (manifest_path(control_ckpt_dir, 13),
+                    os.path.join(control_ckpt_dir, "epoch_override-13")):
+        if os.path.exists(sidecar):
+            # fmlint: disable=R005 -- part of the same simulated
+            # by-hand cleanup in the control twin
+            os.remove(sidecar)
+    # The fault: tear the newest step's largest array file.
+    victim = truncate_checkpoint(cfg.model_file, seed=seed)
+    assert victim and f"{os.sep}13{os.sep}" in victim, victim
+    # Run 2: restart onto the torn state; must self-heal.
+    cfg2 = _cfg(workdir, data, epoch_num=2)
+    table_fb = np.asarray(train(cfg2))
+    log = open(cfg2.log_file).read()
+    assert "restored checkpoint at step 10" in log, (
+        "fallback run did not resume from the previous intact step")
+    quarantined = [n for n in os.listdir(ckpt_dir)
+                   if n.startswith(QUARANTINE_PREFIX)]
+    assert quarantined == [f"{QUARANTINE_PREFIX}13"], quarantined
+    assert 13 not in list_step_dirs(ckpt_dir)
+    victim_rel = os.path.relpath(victim, os.path.join(ckpt_dir, "13"))
+    assert os.path.exists(os.path.join(ckpt_dir, quarantined[0],
+                                       victim_rel)), (
+        "quarantine must preserve (not delete) the torn bytes")
+    c = _counters(cfg2)
+    assert c.get("checkpoint/fallbacks") == 1, c
+    assert c.get("checkpoint/quarantined_steps") == 1, c
+    assert c.get("checkpoint/saves", 0) >= 4, c
+    v = _verdict(cfg2)
+    assert v.startswith("OK (ckpt fallback x1"), v
+    # Control twin: clean resume from step 10 over the same corpus.
+    table_ctl = np.asarray(train(control_cfg))
+    assert np.array_equal(table_fb, table_ctl), (
+        "fallback resume diverged from a clean resume off the same "
+        "step: max |delta| = "
+        f"{np.abs(table_fb - table_ctl).max()}")
+    # Both twins completed the 2-epoch schedule from step 10.
+    ckpt = CheckpointState(cfg2.model_file)
+    restored = ckpt.restore(template=checkpoint_template(cfg2))
+    ckpt.close()
+    assert int(restored["step"]) == 10 + 2 * 13
+    assert int(restored["epoch"]) == 2
+    return (f"quarantined torn step 13 -> {quarantined[0]}, resumed "
+            f"from step 10, verdict {v!r}, final table identical to "
+            "the clean-resume control")
+
+
+def scenario_kill_async_save(workdir: str, seed: int = 0) -> str:
+    """SIGKILL a real training child while async saves are in flight
+    (save_steps=1, ~22 MB state widens the write window): the restart's
+    VERIFIED restore must come up cleanly on a committed step — orbax's
+    atomic commit hides torn step dirs, the manifest check catches
+    anything that slipped through — and complete its schedule."""
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+    from fast_tffm_tpu.checkpoint import list_step_dirs
+    workdir = os.path.abspath(workdir)
+    data = os.path.join(workdir, "train_kill.txt")
+    _write_corpus(data, 2000, seed)
+    model = os.path.join(workdir, "model", "fm")
+    cfg_path = os.path.join(workdir, "kill.cfg")
+    with open(cfg_path, "w") as fh:
+        fh.write(f"""
+[General]
+vocabulary_size = 300000
+factor_num = 8
+model_file = {model}
+
+[Train]
+train_files = {data}
+epoch_num = 50
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+save_steps = 1
+log_steps = 0
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    proc = subprocess.Popen(
+        [sys.executable, "run_tffm.py", "train", cfg_path],
+        cwd=repo, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    ckpt_dir = model + ".ckpt"
+    try:
+        # Kill once a second step commits: the NEXT async write is then
+        # likely mid-flight. Generous deadline — the child pays
+        # interpreter + jax + jit startup on a possibly loaded host.
+        deadline = _time.time() + 300
+        while _time.time() < deadline:
+            if len(list_step_dirs(ckpt_dir)) >= 2:
+                break
+            _time.sleep(0.02)
+        else:
+            raise AssertionError(
+                "child never committed 2 checkpoint steps")
+        killed_at = max(list_step_dirs(ckpt_dir))
+        proc.send_signal(signal.SIGKILL)
+    finally:
+        if proc.poll() is None:  # assertion path: don't leak the child
+            proc.kill()
+        proc.wait(timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+    # Restart in-process with verified restore: must come up on a
+    # committed step and finish one epoch.
+    cfg = _cfg(workdir, data, vocabulary_size=300000, factor_num=8,
+               shuffle=False)
+    from fast_tffm_tpu.train import train
+    train(cfg)
+    final_steps = list_step_dirs(ckpt_dir)
+    assert final_steps and final_steps[-1] > killed_at, (
+        killed_at, final_steps)
+    v = _verdict(cfg)
+    assert v.startswith("OK"), v
+    return (f"SIGKILLed child at committed step {killed_at}; restart "
+            f"restored cleanly and finished at step {final_steps[-1]} "
+            f"(verdict {v!r})")
+
+
 SCENARIOS: Dict[str, Callable[..., str]] = {
     "skip": scenario_skip,
     "quarantine": scenario_quarantine,
     "max-bad": scenario_max_bad,
     "flaky-open": scenario_flaky_open,
     "preempt-resume": scenario_preempt_resume,
+    "truncate-latest": scenario_truncate_latest,
+    "kill-async-save": scenario_kill_async_save,
 }
 
 

@@ -161,5 +161,47 @@ def r004_swallowed_exception(path: str, tree: ast.AST) -> List[Finding]:
     return found
 
 
+def r005_ckpt_delete(path: str, tree: ast.AST) -> List[Finding]:
+    """``os.remove``/``os.unlink``/``shutil.rmtree`` aimed at
+    checkpoint state OUTSIDE checkpoint.py: quarantine-not-delete is
+    the state-plane invariant (a bad step dir is renamed
+    ``corrupt-<step>`` so the bytes survive for forensics/recovery;
+    only ``fmckpt gc`` — an explicit operator action — reclaims them).
+    Heuristic: the deleted path's source expression mentions a
+    checkpoint (``ckpt``) or a step dir. Applies to every linted
+    module, not just hot ones — a cold cleanup path deleting a
+    checkpoint is exactly as fatal. Deliberate deletions carry a
+    justified pragma, as with R001–R004."""
+    p = path.replace("\\", "/")
+    if p.endswith("checkpoint.py"):
+        return []
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("remove",
+                                                       "unlink",
+                                                       "rmtree"):
+            name = f.attr
+        elif isinstance(f, ast.Name) and f.id == "rmtree":
+            name = f.id
+        else:
+            continue
+        try:
+            arg_src = ast.unparse(node.args[0])
+        except Exception:  # noqa: BLE001 - unparsable arg: skip
+            continue
+        low = arg_src.lower()
+        if "ckpt" in low or "step_dir" in low:
+            found.append(Finding(
+                "R005", path, node.lineno,
+                f"{name}() on a checkpoint path outside checkpoint.py "
+                "breaks the quarantine-not-delete invariant; rename to "
+                "corrupt-<step> (CheckpointState.quarantine_step) or "
+                "justify with a pragma"))
+    return found
+
+
 RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter,
-         r004_swallowed_exception)
+         r004_swallowed_exception, r005_ckpt_delete)
