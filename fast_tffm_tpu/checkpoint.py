@@ -487,8 +487,10 @@ class CheckpointState:
                 override = ov
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            override = int(multihost_utils.broadcast_one_to_all(
-                np.int64(override)))
+            from fast_tffm_tpu.parallel.liveness import guarded_collective
+            override = int(guarded_collective(
+                multihost_utils.broadcast_one_to_all,
+                np.int64(override), label="checkpoint/epoch_override"))
         if override >= 0:
             restored["epoch"] = np.int64(override)
         return restored
@@ -556,7 +558,13 @@ class CheckpointState:
         if jax.process_count() <= 1:
             return int(value)
         from jax.experimental import multihost_utils
-        return int(multihost_utils.broadcast_one_to_all(np.int64(value)))
+        from fast_tffm_tpu.parallel.liveness import guarded_collective
+        # Deadline-guarded (parallel/liveness.py): a peer that dies
+        # mid-restore must raise WorkerLostError on the survivors, not
+        # park them in the step-decision broadcast forever.
+        return int(guarded_collective(
+            multihost_utils.broadcast_one_to_all, np.int64(value),
+            label="checkpoint/step_decision"))
 
     def _all_agree(self, flag: bool) -> bool:
         """True only when EVERY process reports ``flag`` true (tiny
@@ -570,8 +578,10 @@ class CheckpointState:
         if jax.process_count() <= 1:
             return bool(flag)
         from jax.experimental import multihost_utils
-        flags = multihost_utils.process_allgather(
-            np.asarray([bool(flag)]))
+        from fast_tffm_tpu.parallel.liveness import guarded_collective
+        flags = guarded_collective(
+            multihost_utils.process_allgather,
+            np.asarray([bool(flag)]), label="checkpoint/restore_agree")
         return bool(np.asarray(flags).all())
 
     def _pick_intact_step(self) -> Tuple[int, int]:

@@ -192,6 +192,32 @@ class FmConfig:
     # start into a failed job — but a worker must never hang forever
     # on a coordinator that will never come up.
     cluster_connect_timeout_seconds: float = 300.0
+    # Compute-plane fault tolerance (README "Elastic multi-host";
+    # parallel/liveness.py). Deadline on every blocking host collective
+    # (lockstep window allgathers, restore broadcasts, barrier syncs):
+    # on expiry the liveness table is consulted, a `health: worker_lost`
+    # diagnosis names the peers that stopped heartbeating, stacks are
+    # dumped, and a WorkerLostError is raised instead of hanging
+    # forever. 0 = no deadline (the historical hang-forever behavior).
+    collective_timeout_seconds: float = 300.0
+    # Heartbeat-lease renewal interval: each worker renews a lease file
+    # in <model_file>.hb/ on a daemon thread (liveness = process alive,
+    # not making progress); a peer is presumed lost once its lease is
+    # ~4 intervals old. The lease's monitor thread is also what
+    # enforces collective_timeout_seconds on a BLOCKED collective, and
+    # its presence is what allows jax's own abort-all-survivors death
+    # detection to be replaced. 0 disables the layer entirely: jax's
+    # native detection stays on (survivors abort ~100s after a task
+    # death instead of diagnosing and recovering), and the deadline
+    # guard only converts collectives that RAISE. elastic = shrink
+    # requires it.
+    heartbeat_seconds: float = 5.0
+    # What survivors do on WorkerLostError: "off" fails fast with the
+    # named-worker diagnosis; "shrink" tears down the distributed
+    # client, reforms the cluster from the surviving membership,
+    # redistributes the lost worker's input shards, restores from the
+    # last verified checkpoint, and continues.
+    elastic: str = "off"            # "off" | "shrink"
 
     def __post_init__(self):
         if self.order < 2:
@@ -299,6 +325,22 @@ class FmConfig:
             raise ValueError(
                 f"cluster_connect_timeout_seconds must be > 0, got "
                 f"{self.cluster_connect_timeout_seconds}")
+        if self.collective_timeout_seconds < 0:
+            raise ValueError(
+                f"collective_timeout_seconds must be >= 0 (0 = no "
+                f"deadline), got {self.collective_timeout_seconds}")
+        if self.heartbeat_seconds < 0:
+            raise ValueError(
+                f"heartbeat_seconds must be >= 0 (0 = liveness off), "
+                f"got {self.heartbeat_seconds}")
+        if self.elastic not in ("off", "shrink"):
+            raise ValueError(
+                f"unknown elastic {self.elastic!r} (want off | shrink)")
+        if self.elastic == "shrink" and not self.heartbeat_seconds:
+            raise ValueError(
+                "elastic = shrink requires heartbeat_seconds > 0: "
+                "surviving membership is decided from the heartbeat "
+                "leases in <model_file>.hb/")
         if self.weight_files and not self.train_files:
             # Mirror of the validation_weight_files check above: a
             # sidecar list with nothing to pair against is always a
@@ -410,6 +452,9 @@ _CLUSTER_KEYS = {
     "ps_hosts": _split_files,
     "worker_hosts": _split_files,
     "cluster_connect_timeout_seconds": float,
+    "collective_timeout_seconds": float,
+    "heartbeat_seconds": float,
+    "elastic": str,
 }
 
 

@@ -171,6 +171,12 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
         "checkpoint_fallbacks": c.get("checkpoint/fallbacks", 0),
         "checkpoint_quarantined": c.get("checkpoint/quarantined_steps",
                                         0),
+        # Compute-plane accounting (README "Elastic multi-host"):
+        # peers that stopped heartbeating, elastic shrink recoveries,
+        # and cluster bring-ups that exhausted their retry budget.
+        "workers_lost": c.get("cluster/workers_lost", 0),
+        "elastic_recoveries": c.get("cluster/elastic_recoveries", 0),
+        "bringup_failures": c.get("cluster/bringup_failures", 0),
     }
 
     # Predict-path stats (a predict stream has no train loop at all;
@@ -250,21 +256,23 @@ def _bench_verdict(ceil: Dict[str, float]) -> str:
 
 def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
     """The run-health verdict line for one merged summary (obs/health):
-    ``{"verdict": "OK" | "PREEMPTED" | "STALLED" | "NONFINITE" |
-    "CRASHED", "detail": ...}``. Read purely from explicit stream
-    events — severity order CRASHED > NONFINITE > PREEMPTED > STALLED,
-    because a crash ends the run while a survived stall merely delayed
-    it, and a preemption (train's SIGTERM/SIGINT save-and-exit path
-    emits ``health: preempted``) is a CLEAN exit that must not read as
-    a crash — the run saved, and a restart resumes it. A run that
-    RECOVERED from a bad checkpoint (``health: ckpt_fallback``:
-    restore quarantined the newest step and fell back) reads as
-    ``OK (ckpt fallback xN)`` — healed, but never silently green: the
-    operator should know state was lost and a corrupt-<step> dir is
-    waiting for fmckpt. A stream that never wrote its run_end gets
-    flagged in the detail either way (a hard-killed run writes no
-    crash event; a live run hasn't finished — the reader knows which
-    one it is holding)."""
+    ``{"verdict": "OK" | "PREEMPTED" | "DEGRADED (N workers lost)" |
+    "STALLED" | "NONFINITE" | "CRASHED", "detail": ...}``. Read purely
+    from explicit stream events — severity order CRASHED > NONFINITE >
+    PREEMPTED > DEGRADED > STALLED, because a crash ends the run while
+    a survived stall merely delayed it; a preemption (train's SIGTERM/
+    SIGINT save-and-exit path emits ``health: preempted``) is a CLEAN
+    exit that must not read as a crash — the run saved, and a restart
+    resumes it; and a DEGRADED run (``health: worker_lost`` diagnoses
+    from the collective deadline guard, usually paired with
+    ``elastic_recovered``) finished its work on a shrunken cluster —
+    healed, but never silently green: the operator should know N
+    workers' capacity is gone and the dead workers' shard streams end
+    without a run_end. A run that RECOVERED from a bad checkpoint
+    (``health: ckpt_fallback``) reads as ``OK (ckpt fallback xN)``. A
+    stream that never wrote its run_end gets flagged in the detail
+    either way (a hard-killed run writes no crash event; a live run
+    hasn't finished — the reader knows which one it is holding)."""
     crashes = summary.get("crash_events") or []
     health = summary.get("health_events") or []
     stalls = [h for h in health if h.get("status") == "stalled"]
@@ -274,12 +282,16 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
     nonfin = [h for h in health
               if str(h.get("status", "")).startswith("nonfinite")]
     preempts = [h for h in health if h.get("status") == "preempted"]
+    lost_events = [h for h in health
+                   if h.get("status") == "worker_lost"]
+    elastic = [h for h in health
+               if h.get("status") == "elastic_recovered"]
     unclosed = (summary.get("run_starts", 0)
                 > summary.get("run_ends", 0))
     notes = []
     if unclosed:
-        notes.append("stream has no run_end (hard kill, or still "
-                     "running)")
+        notes.append("stream has no run_end (hard kill, still "
+                     "running, or a lost worker's shard)")
     if crashes:
         first = crashes[0]
         err = str(first.get("error", "?"))
@@ -303,6 +315,29 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                      f"{last.get('step', '?')} (epoch "
                      f"{last.get('epoch', '?')}); the run saved and "
                      "exited cleanly — restart to resume"] + notes)}
+    if lost_events:
+        lost_ids = sorted(
+            {int(p.get("process_index", -1))
+             for h in lost_events for p in (h.get("lost") or [])}
+            | {int(p) for h in elastic for p in (h.get("lost") or [])})
+        n = max(len(lost_ids), 1)
+        who = (", ".join(f"process {p}" for p in lost_ids)
+               if lost_ids else "unnamed peer(s)")
+        if elastic:
+            gens = max(int(h.get("generation", 0)) for h in elastic)
+            members = (elastic[-1].get("members") or [])
+            how = (f"elastic shrink recovered x{len(elastic)} "
+                   f"(generation {gens}, {len(members)} survivor(s)); "
+                   "the run continued on the shrunken cluster")
+        else:
+            how = ("no elastic recovery recorded — the run failed "
+                   "fast with the diagnosis (elastic = off) or was "
+                   "still recovering")
+        return {"verdict": f"DEGRADED ({n} worker"
+                           f"{'s' if n != 1 else ''} lost)",
+                "detail": "; ".join(
+                    [f"collective deadline guard / heartbeat monitor "
+                     f"lost {who}; {how}"] + notes)}
     if stalls:
         worst = max(float(h.get("stalled_seconds") or 0) for h in stalls)
         rec = (f", recovered x{len(recoveries)}" if recoveries
@@ -347,6 +382,39 @@ def padding_waste(counters: Dict[str, float]) -> Optional[float]:
     if not slots:
         return None
     return max(0.0, 1.0 - (nnz or 0.0) / slots)
+
+
+def worker_table(summary: Dict[str, Any]) -> List[str]:
+    """Per-worker liveness rows (one line per process that published
+    ``worker/*`` gauges — multi-process runs with the heartbeat lease
+    on): last heartbeat age at the final flush, lockstep windows
+    completed, and examples processed. A worker named lost by a
+    ``health: worker_lost`` diagnosis is flagged LOST — its row
+    freezes at whatever its shard file last flushed."""
+    lost_ids = set()
+    for h in summary.get("health_events") or []:
+        if h.get("status") == "worker_lost":
+            for p in h.get("lost") or []:
+                # fmlint: disable=R001 -- parsed JSON event fields,
+                # host values only (this is the offline read side)
+                lost_ids.add(int(p.get("process_index", -1)))
+        elif h.get("status") == "elastic_recovered":
+            # fmlint: disable=R001 -- parsed JSON event fields
+            lost_ids.update(int(p) for p in h.get("lost") or [])
+    rows = []
+    for proc in sorted(summary.get("gauges_by_process", {})):
+        g = summary["gauges_by_process"][proc]
+        if not any(k.startswith("worker/") for k in g):
+            continue
+        age = g.get("worker/heartbeat_age_seconds")
+        age_s = ("-" if age is None or age < 0
+                 else f"{age:.1f}s")
+        flag = "  LOST" if proc in lost_ids else ""
+        rows.append(
+            f"p{proc}: hb age {age_s}  windows "
+            f"{_fmt(g.get('worker/windows', 0))}  examples "
+            f"{_fmt(g.get('worker/examples', 0))}{flag}")
+    return rows
 
 
 def _fmt(v: Any) -> str:
@@ -397,6 +465,9 @@ def render(summary: Dict[str, Any]) -> str:
         ("ckpt fallbacks / quarantined steps",
          f"{_fmt(att['checkpoint_fallbacks'])} / "
          f"{_fmt(att['checkpoint_quarantined'])}"),
+        ("workers lost / elastic recoveries",
+         f"{_fmt(att['workers_lost'])} / "
+         f"{_fmt(att['elastic_recoveries'])}"),
     ]
     if att["predict_examples"]:
         rows += [
@@ -408,6 +479,11 @@ def render(summary: Dict[str, Any]) -> str:
         ]
     for k, v in rows:
         lines.append(f"  {k:<34} {_fmt(v)}")
+    worker_rows = worker_table(summary)
+    if worker_rows:
+        lines.append("  workers (per-process liveness):")
+        for row in worker_rows:
+            lines.append(f"    {row}")
     if "ceilings" in att:
         lines.append("  bench ceilings (examples/sec):")
         for k in ("e2e", "host_only", "device_only", "h2d_only"):

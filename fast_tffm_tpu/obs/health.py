@@ -191,6 +191,70 @@ def emit_ckpt_fallback(step: int, reason: str, quarantined: str) -> None:
     tel.sink.flush()
 
 
+def emit_worker_lost(lost, label: str,
+                     timeout_seconds: Optional[float] = None,
+                     error: Optional[str] = None) -> None:
+    """The compute-plane diagnosis event (parallel/liveness.py): a
+    ``health: worker_lost`` record naming exactly which peers stopped
+    heartbeating (process id, host, lease age), flushed straight to
+    disk — the survivors' very next move is to tear the distributed
+    client down (elastic) or exit, so the evidence must already be
+    durable. Counts ``cluster/workers_lost`` once per named peer.
+    No-op without an active run (fake-clock unit tests install their
+    own telemetry)."""
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is None:
+        return
+    fields = {
+        "status": "worker_lost",
+        "label": str(label),
+        "lost": [{"process_index": i.process_index, "host": i.host,
+                  "pid": i.pid, "age_seconds": i.age_seconds}
+                 for i in lost],
+    }
+    if timeout_seconds is not None:
+        fields["timeout_seconds"] = float(timeout_seconds)
+    if error is not None:
+        fields["error"] = str(error)[:300]
+    # The same dead peer is diagnosed from several angles (the lease
+    # monitor's episode, the failed collective's conversion, the
+    # deadline escalation) — the counter must say how many WORKERS
+    # were lost, not how many paths noticed, so it counts each process
+    # id once per run (the events themselves all land for forensics).
+    seen = getattr(tel, "_workers_lost_counted", None)
+    if seen is None:
+        seen = tel._workers_lost_counted = set()
+    fresh = {i.process_index for i in lost} - seen
+    if not lost:
+        fresh = {-1} - seen  # unnamed diagnosis: count once
+    if fresh:
+        seen.update(fresh)
+        tel.count("cluster/workers_lost", len(fresh))
+    tel.sink.emit("health", fields)
+    tel.sink.flush()
+
+
+def emit_elastic_recovery(generation: int, members,
+                          lost) -> None:
+    """The elastic-shrink success event: survivors reformed into
+    cluster generation ``generation`` with ``members`` (original
+    process indices) after losing ``lost``. fmstat's DEGRADED verdict
+    reads these alongside the worker_lost diagnoses."""
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is None:
+        return
+    tel.count("cluster/elastic_recoveries")
+    tel.sink.emit("health", {
+        "status": "elastic_recovered",
+        "generation": int(generation),
+        "members": [int(m) for m in members],
+        "lost": [int(p) for p in lost],
+    })
+    tel.sink.flush()
+
+
 def format_crash(exc: BaseException, limit_chars: int = 8000) -> str:
     """The traceback text a crash event carries, tail-truncated (the
     frames nearest the raise are the forensic payload)."""

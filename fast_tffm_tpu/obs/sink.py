@@ -173,6 +173,18 @@ class JsonlSink:
         self._drain_scalars()
         self.flush()
 
+    def discard_scalars(self) -> int:
+        """Drop queued device scalars WITHOUT fetching them — the
+        compute-plane recovery path (parallel/liveness.py): after a
+        peer dies, a buffered loss scalar may be the output of a
+        collective program that will never complete, and draining it
+        would park the survivor in the exact hang the deadline guard
+        just escaped. Returns the number dropped (recorded by the
+        caller's telemetry so the gap is visible, not silent)."""
+        n = len(self._scalars)
+        self._scalars.clear()
+        return n
+
     def close(self) -> None:
         if self._closed:
             return

@@ -90,9 +90,18 @@ def _git_rev() -> Optional[str]:
         return None  # telemetry must never block a run on git
 
 
-def run_meta(cfg, kind: str) -> Dict[str, Any]:
+def run_meta(cfg, kind: str, process_index: Optional[int] = None,
+             process_count: Optional[int] = None) -> Dict[str, Any]:
     """Run metadata stamped into every metrics event: config hash,
-    backend, device/process topology, git rev."""
+    backend, device/process topology, git rev. ``process_index`` /
+    ``process_count`` override jax's view — the train driver creates
+    telemetry BEFORE the cluster join (so bring-up failures land in
+    the stream), when jax would still claim a 1-process local world on
+    every worker; the launcher-assigned task index and the config's
+    worker count are the stable identities. (backend/device_count are
+    the pre-join LOCAL view in that case; the driver refreshes the
+    meta dict in place once the cluster is up, so metrics events
+    carry the real topology.)"""
     import os
     import jax
     return {
@@ -100,8 +109,10 @@ def run_meta(cfg, kind: str) -> Dict[str, Any]:
         "config_hash": config_hash(cfg) if cfg is not None else None,
         "backend": jax.default_backend(),
         "device_count": jax.device_count(),
-        "process_index": jax.process_index(),
-        "process_count": jax.process_count(),
+        "process_index": (jax.process_index() if process_index is None
+                          else int(process_index)),
+        "process_count": (jax.process_count() if process_count is None
+                          else int(process_count)),
         "git_rev": _git_rev(),
         "pid": os.getpid(),
         "start_time": time.time(),
@@ -128,6 +139,11 @@ class RunTelemetry:
         # Span tracing (obs/trace.py): span() reads this flag through
         # active(), so the off cost at every site stays one global read.
         self.trace_spans = bool(trace_spans)
+        # Compute-plane liveness (parallel/liveness.py): the train/
+        # predict drivers attach their HeartbeatLease here so every
+        # metrics flush carries per-worker liveness gauges (the fmstat
+        # worker table) without the registry growing a liveness import.
+        self.lease = None
         # Run-health watchdog (obs/health.py): a daemon thread fed by
         # heartbeat(); owns the stall/stack-dump forensics.
         self.watchdog = None
@@ -196,7 +212,26 @@ class RunTelemetry:
         now = time.perf_counter()
         self.registry.set("flush/window_seconds", now - self._last_flush)
         self._last_flush = now
-        self.sink.emit_metrics(step, self.registry.snapshot())
+        snap = self.registry.snapshot()
+        lease = self.lease
+        if lease is not None:
+            # Per-worker liveness row (fmstat worker table): this
+            # worker's own heartbeat age plus its share of the lockstep
+            # work, as GAUGES — counters fold across processes at merge
+            # time, gauges stay per-process (gauges_by_process).
+            c = snap["counters"]
+            age = lease.age()
+            rows = {
+                "worker/heartbeat_age_seconds":
+                    round(age, 3) if age is not None else -1.0,
+                "worker/windows": c.get("lockstep/windows", 0.0),
+                "worker/examples": c.get("train/examples",
+                                         c.get("predict/examples", 0.0)),
+            }
+            for k, v in rows.items():
+                self.registry.set(k, v)
+            snap["gauges"].update(rows)
+        self.sink.emit_metrics(step, snap)
 
     def close(self, step: int = -1) -> None:
         if self._closed:
@@ -251,32 +286,43 @@ class RunTelemetry:
         self.count("train/h2d_bytes", h2d_bytes)
 
 
-def resolve_metrics_path(cfg) -> Optional[str]:
+def resolve_metrics_path(cfg,
+                         process_index: Optional[int] = None
+                         ) -> Optional[str]:
     """The JSONL path this process should write, or None when metrics
     are off. ``metrics_file = auto`` follows the sibling-artifact
     convention (<model_file>.tb/, <model_file>.ckpt/):
     <model_file>.metrics.jsonl. Non-chief processes get a .p<i> shard
-    suffix so P workers never interleave writes in one file."""
+    suffix so P workers never interleave writes in one file.
+    ``process_index`` overrides jax's view (see run_meta) — and stays
+    the worker's ORIGINAL index across elastic re-ranks, so one worker
+    writes one shard file for the whole run."""
     path = getattr(cfg, "metrics_file", "") or ""
     if not path:
         return None
     if path == "auto":
         path = cfg.model_file + ".metrics.jsonl"
-    import jax
-    p = jax.process_index()
+    if process_index is None:
+        import jax
+        process_index = jax.process_index()
+    p = int(process_index)
     return path if p == 0 else f"{path}.p{p}"
 
 
-def make_telemetry(cfg, kind: str) -> Optional[RunTelemetry]:
+def make_telemetry(cfg, kind: str,
+                   process_index: Optional[int] = None,
+                   process_count: Optional[int] = None
+                   ) -> Optional[RunTelemetry]:
     """The driver entry point: a RunTelemetry per the config's metrics
     knobs, or None (the default — metrics_file unset)."""
-    path = resolve_metrics_path(cfg)
+    path = resolve_metrics_path(cfg, process_index=process_index)
     if path is None:
         return None
     # getattr defaults: tests (and bench) build pared-down cfg objects
     # that predate the tracing/watchdog knobs.
     return RunTelemetry(
-        path, meta=run_meta(cfg, kind),
+        path, meta=run_meta(cfg, kind, process_index=process_index,
+                            process_count=process_count),
         flush_steps=cfg.metrics_flush_steps,
         trace_spans=getattr(cfg, "trace_spans", False),
         watchdog_stall_seconds=getattr(cfg, "watchdog_stall_seconds",

@@ -18,7 +18,7 @@ a job that listed N ps hosts simply doesn't start them.
 from __future__ import annotations
 
 import time
-from typing import Callable, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from fast_tffm_tpu.config import FmConfig
 
@@ -30,16 +30,53 @@ CONNECT_ATTEMPT_CAP_SECONDS = 60.0
 CONNECT_RETRY_SLEEP_SECONDS = 2.0
 
 
-def coordinator_address(cfg: FmConfig) -> str:
+def coordinator_address(cfg: FmConfig, generation: int = 0,
+                        hosts: Optional[Sequence[str]] = None) -> str:
     """worker_hosts[0] with its port shifted up by 1000: the reference's
     worker port serves TF gRPC; the jax.distributed coordinator needs its
     own listening port, derived deterministically so every process
-    computes the same address from the shared config."""
-    host = cfg.worker_hosts[0]
+    computes the same address from the shared config.
+
+    ``generation`` (elastic recovery) bumps the port once per cluster
+    reform: the previous generation's coordinator socket may still sit
+    in TIME_WAIT — or belong to the dead worker — and every survivor
+    derives the same bumped address without a side channel. ``hosts``
+    overrides the config's worker list (the reform passes the
+    SURVIVING hosts; the new chief is the first of them)."""
+    host = (hosts if hosts is not None else cfg.worker_hosts)[0]
     if ":" in host:
         name, port = host.rsplit(":", 1)
-        return f"{name}:{int(port) + 1000}"
-    return f"{host}:8476"
+        return f"{name}:{int(port) + 1000 + int(generation)}"
+    return f"{host}:{8476 + int(generation)}"
+
+
+def _emit_bringup_failed(address: str, process_id: int, attempts: int,
+                         timeout_seconds: float,
+                         last_error: Exception) -> None:
+    """``health: cluster_bringup_failed`` on the active telemetry
+    stream, flushed before the caller raises: the exception alone is
+    invisible to fmstat post-mortems — an operator reading the stream
+    of a job that never formed must see WHICH process gave up on WHICH
+    coordinator. No-op without an active run."""
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is None:
+        return
+    try:
+        tel.count("cluster/bringup_failures")
+        tel.sink.emit("health", {
+            "status": "cluster_bringup_failed",
+            "coordinator": address,
+            "process_index": int(process_id),
+            "attempts": int(attempts),
+            "timeout_seconds": float(timeout_seconds),
+            "error": f"{type(last_error).__name__}: "
+                     f"{str(last_error)[:300]}",
+        })
+        tel.sink.flush()
+    except Exception:  # noqa: BLE001 - forensics must never mask the
+        # actionable bring-up error about to be raised
+        pass
 
 
 def initialize_with_retry(initialize: Callable[..., None], address: str,
@@ -65,6 +102,8 @@ def initialize_with_retry(initialize: Callable[..., None], address: str,
     while True:
         remaining = deadline - clock()
         if remaining <= 0:
+            _emit_bringup_failed(address, process_id, attempts,
+                                 timeout_seconds, last_error)
             raise RuntimeError(
                 f"process {process_id} failed to join the "
                 f"jax.distributed cluster: coordinator {address} did "
@@ -118,6 +157,28 @@ def init_from_cluster(cfg: FmConfig, job_name: str,
                          f"{len(hosts)} worker_hosts")
     if len(hosts) <= 1:
         return 0, 1
+    _join_cluster(cfg, address=coordinator_address(cfg),
+                  num_processes=len(hosts), process_id=task_index)
+    return task_index, len(hosts)
+
+
+def _liveness_owns_death_detection(cfg: FmConfig) -> bool:
+    """jax's own death detection (abort every survivor ~100s after any
+    task death) is replaced ONLY when the heartbeat-lease layer is on
+    to do the job instead — with ``heartbeat_seconds = 0`` there is no
+    monitor thread to enforce the collective deadline, and disabling
+    both layers would make a dead peer an UNBOUNDED hang (strictly
+    worse than the historical abort)."""
+    return getattr(cfg, "heartbeat_seconds", 0) > 0
+
+
+def _join_cluster(cfg: FmConfig, address: str, num_processes: int,
+                  process_id: int) -> None:
+    """Clear any pre-existing backends, assert the platform/collectives
+    config, and join the jax.distributed job at ``address`` as process
+    ``process_id`` of ``num_processes`` — shared by the initial
+    bring-up and the elastic reform (which must rebuild the exact same
+    client state against a different membership)."""
     import os
 
     import jax
@@ -140,7 +201,10 @@ def init_from_cluster(cfg: FmConfig, job_name: str,
 
     def _initialize(**kw):
         try:
-            jax.distributed.initialize(**kw)
+            if _liveness_owns_death_detection(cfg):
+                _initialize_resilient(**kw)
+            else:
+                jax.distributed.initialize(**kw)
         except Exception:
             # A failed connect leaves the half-built client in
             # jax.distributed's global state (the client is registered
@@ -155,13 +219,180 @@ def init_from_cluster(cfg: FmConfig, job_name: str,
 
     initialize_with_retry(
         _initialize,
-        address=coordinator_address(cfg),
-        num_processes=len(hosts),
-        process_id=task_index,
+        address=address,
+        num_processes=num_processes,
+        process_id=process_id,
         timeout_seconds=getattr(cfg, "cluster_connect_timeout_seconds",
                                 300.0))
-    if jax.process_count() != len(hosts):
+    if jax.process_count() != num_processes:
         raise RuntimeError(
             "jax.distributed did not federate the cluster: expected "
-            f"{len(hosts)} processes, got {jax.process_count()}")
-    return task_index, len(hosts)
+            f"{num_processes} processes, got {jax.process_count()}")
+
+
+# jax's own death detection is DISABLED at bring-up (heartbeat budget
+# pushed out ~3 years): its only response to a dead task is a
+# LOG(FATAL) that ABORTS every surviving process ~100s after the loss
+# — the exact opposite of this module's job. The liveness layer
+# (parallel/liveness.py: sub-10s lease staleness, named diagnosis,
+# elastic recovery) replaces it; transport-level failures still
+# surface organically as collective errors, which the deadline guard
+# converts.
+_DISABLED_HEARTBEAT_KWARGS = dict(
+    service_heartbeat_interval_seconds=100_000_000,
+    service_max_missing_heartbeats=1_000,
+    client_heartbeat_interval_seconds=100_000_000,
+    client_max_missing_heartbeats=1_000,
+)
+
+
+def _initialize_resilient(coordinator_address: str, num_processes: int,
+                          process_id: int,
+                          initialization_timeout: int = 300) -> None:
+    """jax.distributed.initialize with survivable failure semantics:
+    identical global-state wiring (the public function forwards to
+    this same ``global_state.initialize``), but with the runtime's
+    die-with-the-first-casualty heartbeat detection pushed out of the
+    picture (see ``_DISABLED_HEARTBEAT_KWARGS``). Falls back to the
+    plain public call on signature drift — the cluster still works
+    there, only the abort-on-peer-death default returns."""
+    import jax
+    from jax._src import distributed as _dist
+    try:
+        _dist.global_state.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            initialization_timeout=initialization_timeout,
+            **_DISABLED_HEARTBEAT_KWARGS)
+    except TypeError:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id,
+            initialization_timeout=initialization_timeout)
+
+
+# Strong references to retired runtime clients/services: their gRPC
+# threads may still be parked on a dead peer, and a destructor-driven
+# shutdown from GC could block or abort mid-recovery. One entry per
+# lost-worker incident — a deliberate, bounded leak.
+_RETIRED: List[Tuple] = []
+
+
+def has_retired_clients() -> bool:
+    """True when this process retired a dead cluster's runtime client
+    (elastic recovery or fail-fast). The CLI checks this to exit via
+    ``os._exit`` after sinks close: interpreter teardown would destroy
+    the retired service, whose call cancellation trips the retired
+    client's error-poll handler — a LOG(FATAL) abort AFTER a perfectly
+    clean run. All durable state (checkpoint, metrics, logs, exports)
+    is closed by then; skipping C++ teardown of already-dead cluster
+    plumbing is the correct exit."""
+    return bool(_RETIRED)
+
+
+def retire_distributed_client() -> None:
+    """Drop the jax.distributed client/service WITHOUT the shutdown
+    handshake. A clean ``shutdown()`` runs the coordination service's
+    Shutdown barrier, which by definition cannot complete while a
+    registered peer is dead — it stalls for its full timeout and then
+    (with jaxlib's default callback) aborts the process. After a
+    WorkerLostError the old cluster is unrecoverable anyway: keep the
+    objects alive (no destructor side effects), reset the global
+    state so a reform (or a lone-survivor fallback to single-process)
+    can rebuild from scratch, and restore the local-backend config."""
+    import jax
+    import jax.extend.backend
+    from jax._src import distributed as _dist
+    state = _dist.global_state
+    _RETIRED.append((state.client, state.service,
+                     getattr(state, "preemption_sync_manager", None)))
+    _dist.global_state = type(state)()
+    # The gloo CPU-collectives setting outlives the client it needs: a
+    # lone survivor rebuilding its LOCAL backend would fail inside
+    # make_gloo_tcp_collectives(distributed_client=None). Reset to the
+    # default; _join_cluster re-asserts gloo when a shrunken
+    # multi-process cluster actually reforms.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "none")
+    except Exception:
+        pass
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+
+
+def reform_shrunken_cluster(cfg: FmConfig, lease, generation: int,
+                            logger=None) -> Tuple[int, int, List[int]]:
+    """Rebuild the SPMD job from the surviving membership after a
+    WorkerLostError (elastic = shrink):
+
+    1. retire the old distributed client (no shutdown handshake — see
+       ``retire_distributed_client``);
+    2. announce readiness for cluster generation ``generation`` in the
+       heartbeat rendezvous dir and wait until every LIVE lease holder
+       has announced and the set holds still for a settle window —
+       survivors' guard deadlines expire at slightly different times,
+       so membership is only committed once it stops changing;
+    3. re-rank: survivors sorted by ORIGINAL process index; the first
+       survivor's host becomes the new coordinator at a
+       generation-bumped port; ``initialize_with_retry`` forms the
+       shrunken job (a lone survivor skips jax.distributed entirely
+       and simply continues single-process).
+
+    Returns ``(new_shard_index, num_shards, members)`` — the members
+    list holds the survivors' original indices, which is also the new
+    input-shard order, so the lost worker's byte ranges redistribute
+    across everyone at the next epoch pass. The lease's expected
+    membership is shrunk in place so departed workers stop being
+    reported lost forever after."""
+    from fast_tffm_tpu.parallel.liveness import REFORM_SETTLE_SECONDS
+    log = logger or _silent_logger()
+    retire_distributed_client()
+    lease.announce_reform(generation)
+    budget = getattr(cfg, "cluster_connect_timeout_seconds", 300.0)
+    deadline = time.monotonic() + budget
+    members: List[int] = []
+    stable_since: Optional[float] = None
+    while True:
+        live = set(lease.live_members())
+        announced = set(lease.reform_members(generation))
+        agreed = sorted(live & announced)
+        now = time.monotonic()
+        if agreed and live <= announced:
+            if agreed != members:
+                members, stable_since = agreed, now
+            elif (stable_since is not None
+                  and now - stable_since >= REFORM_SETTLE_SECONDS):
+                break
+        else:
+            members, stable_since = agreed, None
+        if now >= deadline:
+            raise RuntimeError(
+                f"elastic reform generation {generation} did not "
+                f"converge within cluster_connect_timeout_seconds="
+                f"{budget:g}s: live={sorted(live)} "
+                f"announced={sorted(announced)}")
+        time.sleep(min(0.1, max(lease.heartbeat_seconds / 4, 0.02)))
+    if lease.process_index not in members:
+        raise RuntimeError(
+            f"elastic reform generation {generation}: this process "
+            f"({lease.process_index}) lost its own lease; members="
+            f"{members}")
+    lease.members = tuple(members)
+    rank = members.index(lease.process_index)
+    log.info("elastic reform generation %d: survivors %s, this process "
+             "re-ranks %d -> %d of %d", generation, members,
+             lease.process_index, rank, len(members))
+    if len(members) > 1:
+        hosts = [cfg.worker_hosts[m] for m in members]
+        _join_cluster(cfg,
+                      address=coordinator_address(cfg, generation,
+                                                  hosts=hosts),
+                      num_processes=len(members), process_id=rank)
+    return rank, len(members), members
+
+
+def _silent_logger():
+    from fast_tffm_tpu.utils.logging import get_logger
+    return get_logger()

@@ -1,0 +1,577 @@
+"""Compute-plane liveness: heartbeat leases + collective deadline guards.
+
+The lockstep SPMD port traded away the one robustness property the
+reference's async PS design had by construction: a lost worker there
+just stopped pulling batches, while here every step is a collective
+program and one dead or wedged process parks every peer inside
+``multihost_utils.process_allgather`` forever — the PR 2 watchdog can
+dump the survivors' stacks but cannot say WHO died or unblock anyone.
+This module closes that gap in two layers (train.py composes the third,
+elastic recovery, on top):
+
+- ``HeartbeatLease`` — each process periodically renews a tiny lease
+  file in a shared rendezvous dir (``<model_file>.hb/``, the same
+  shared-filesystem assumption checkpoints and metrics already make).
+  Liveness means "the process is alive", not "it is making progress":
+  the renewal runs on a daemon thread, so a worker blocked in a
+  collective still renews — only a SIGKILLed, SIGSTOPped, or crashed
+  worker goes stale. A daemon monitor tick emits ``health:
+  worker_lost`` events naming the stale peer's process id and host.
+
+- ``guarded_collective(fn, *args)`` — the deadline guard every blocking
+  collective runs under (fmlint R006 enforces this at the host
+  collective call sites; the lockstep step/score dispatches run under
+  it too). A collective that RAISES (a SIGKILLed peer resets the
+  transport within seconds) is converted to a distinct
+  ``WorkerLostError`` naming the peers the lease table shows dead —
+  the recovery entry point. A collective that BLOCKS (a SIGSTOPped
+  peer keeps its sockets open) is watched by the lease monitor
+  thread: past ``collective_timeout_seconds`` with stale peers it
+  emits the named diagnosis, dumps all-thread stacks, and hard-exits
+  ``EXIT_WORKER_LOST`` — a bounded, diagnosed failure instead of an
+  indefinite hang (the blocked thread cannot be interrupted from
+  Python, and dispatching jax programs from helper threads to buy a
+  timeout is memory-unsafe in practice).
+
+Everything here is host-only and clock-injectable: staleness math and
+the guard's decision logic run under fake clocks in tests, no real
+multi-process spawn needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import faulthandler
+import json
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# A lease is stale once it is this many heartbeat intervals old: one
+# interval of ordinary scheduling jitter, one of shared-FS lag, and the
+# rest margin — a live-but-slow worker must not read as dead (a false
+# "lost" verdict shrinks a healthy cluster), while a dead one must go
+# stale well inside any sane collective_timeout_seconds.
+STALE_FACTOR = 4.0
+
+# Elastic reform: after the live set and the announced set first agree,
+# membership must hold still for this long before survivors commit to
+# it — absorbs the skew between survivors' guard expiries.
+REFORM_SETTLE_SECONDS = 1.0
+
+
+class WorkerLostError(RuntimeError):
+    """A blocking collective expired (or failed) and the liveness table
+    names dead peers — the compute-plane analogue of BadInputError.
+    ``lost`` carries the stale peers' lease info for the elastic
+    recovery path; empty when the deadline fired with every peer still
+    heartbeating (a genuine timeout, not a death)."""
+
+    def __init__(self, message: str, lost: Sequence["PeerInfo"] = ()):
+        super().__init__(message)
+        self.lost: Tuple["PeerInfo", ...] = tuple(lost)
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerInfo:
+    """One row of the liveness table."""
+    process_index: int
+    host: str = "?"
+    pid: int = -1
+    age_seconds: Optional[float] = None  # None = lease never written
+
+    def describe(self) -> str:
+        age = ("no lease on disk" if self.age_seconds is None
+               else f"last heartbeat {self.age_seconds:.1f}s ago")
+        return f"process {self.process_index} ({self.host}, {age})"
+
+
+class HeartbeatLease:
+    """One process's lease in the shared rendezvous dir, plus the read
+    side of every peer's.
+
+    ``renew()`` atomically rewrites ``worker-<i>.hb`` with a wall-clock
+    timestamp (``clock`` injectable; wall time because staleness is a
+    CROSS-process comparison — the writer's stamp against the reader's
+    now). ``start()`` runs renew on a daemon thread every
+    ``heartbeat_seconds`` and monitors peers between renewals, emitting
+    one ``health: worker_lost`` per peer per staleness episode.
+    ``members`` is the current expected membership (original process
+    indices) — elastic reform shrinks it so departed workers stop
+    being reported."""
+
+    def __init__(self, directory: str, process_index: int,
+                 members: Sequence[int], heartbeat_seconds: float = 5.0,
+                 host: Optional[str] = None, pid: Optional[int] = None,
+                 stale_after: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        self.directory = directory
+        self.process_index = int(process_index)
+        self.members: Tuple[int, ...] = tuple(sorted(members))
+        self.heartbeat_seconds = float(heartbeat_seconds)
+        self.stale_after = (float(stale_after) if stale_after is not None
+                            else STALE_FACTOR * self.heartbeat_seconds)
+        self.host = host if host is not None else socket.gethostname()
+        self.pid = int(pid if pid is not None else os.getpid())
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reported_lost: set = set()  # one event per episode
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- write side ------------------------------------------------------
+    def lease_path(self, process_index: int) -> str:
+        return os.path.join(self.directory,
+                            f"worker-{process_index}.hb")
+
+    def renew(self) -> None:
+        """Atomic lease rewrite; never raises into the renew loop — a
+        transient shared-FS error must cost one missed beat, not the
+        whole liveness layer (the stale margin absorbs it)."""
+        path = self.lease_path(self.process_index)
+        tmp = f"{path}.tmp.{self.pid}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"process_index": self.process_index,
+                           "host": self.host, "pid": self.pid,
+                           "time": self._clock()}, fh)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    # -- read side -------------------------------------------------------
+    def read(self, process_index: int) -> Optional[Dict]:
+        """A peer's raw lease record, or None (missing/torn/garbled —
+        all read as 'never heard from', the safe direction)."""
+        try:
+            with open(self.lease_path(process_index),
+                      encoding="utf-8") as fh:
+                rec = json.load(fh)
+            float(rec["time"])
+            return rec
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def peer_info(self, process_index: int,
+                  now: Optional[float] = None) -> PeerInfo:
+        rec = self.read(process_index)
+        if rec is None:
+            return PeerInfo(process_index)
+        now = self._clock() if now is None else now
+        return PeerInfo(process_index,
+                        host=str(rec.get("host", "?")),
+                        pid=int(rec.get("pid", -1)),
+                        age_seconds=max(0.0, now - float(rec["time"])))
+
+    def age(self) -> Optional[float]:
+        """Seconds since OUR lease last reached disk (the fmstat
+        worker-table row); None before the first renewal lands."""
+        return self.peer_info(self.process_index).age_seconds
+
+    def stale_peers(self, now: Optional[float] = None) -> List[PeerInfo]:
+        """Members (excluding self) whose lease is older than
+        ``stale_after`` or missing entirely — the diagnosis the
+        deadline guard names."""
+        now = self._clock() if now is None else now
+        out = []
+        for p in self.members:
+            if p == self.process_index:
+                continue
+            info = self.peer_info(p, now=now)
+            if info.age_seconds is None or info.age_seconds > self.stale_after:
+                out.append(info)
+        return out
+
+    def live_members(self, now: Optional[float] = None) -> List[int]:
+        """Members with a fresh lease (self included — our own renew
+        thread keeps ours fresh). The elastic reform's membership
+        source."""
+        now = self._clock() if now is None else now
+        stale = {i.process_index for i in self.stale_peers(now=now)}
+        return [p for p in self.members if p not in stale]
+
+    # -- elastic reform rendezvous --------------------------------------
+    def announce_reform(self, generation: int) -> None:
+        """Publish that this process is ready to reform into cluster
+        generation ``generation`` (idempotent; per-generation files so
+        a later reform can't read an earlier round's announcements)."""
+        path = os.path.join(self.directory,
+                            f"reform-{int(generation)}"
+                            f"-{self.process_index}")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(f"{self.host} {self.pid} {self._clock():.3f}\n")
+
+    def reform_members(self, generation: int) -> List[int]:
+        """Original process indices that announced ``generation``."""
+        prefix = f"reform-{int(generation)}-"
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.startswith(prefix):
+                try:
+                    out.append(int(n[len(prefix):]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    # -- renew/monitor thread -------------------------------------------
+    def check_peers(self) -> List[PeerInfo]:
+        """One monitor tick: emit ``health: worker_lost`` for every
+        member newly gone stale (one event per staleness episode; a
+        peer whose lease resumes re-arms). Returns the newly-lost
+        peers. Called from the daemon loop; tests call it directly
+        under a fake clock."""
+        stale = self.stale_peers()
+        stale_ids = {i.process_index for i in stale}
+        fresh = [i for i in stale
+                 if i.process_index not in self._reported_lost]
+        self._reported_lost &= stale_ids  # recovered peers re-arm
+        for info in fresh:
+            self._reported_lost.add(info.process_index)
+            _emit_worker_lost([info], label="heartbeat_monitor")
+        return fresh
+
+    def start(self) -> "HeartbeatLease":
+        if self._thread is None and self.heartbeat_seconds > 0:
+            self.renew()  # lease exists before anyone can look for it
+
+            def loop():
+                while not self._stop.wait(self.heartbeat_seconds):
+                    self.renew()
+                    try:
+                        self.check_peers()
+                        check_deadline()  # collective deadline
+                        # sentinel: the blocked main thread cannot
+                        # time itself out (see guard module comment)
+                    except Exception:  # noqa: BLE001 - the monitor
+                        # must outlive a bad tick; staleness is
+                        # re-evaluated every interval anyway
+                        pass
+            self._thread = threading.Thread(target=loop,
+                                            name="heartbeat-lease",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, remove: bool = True) -> None:
+        """Stop renewing; ``remove`` drops our lease file so a clean
+        exit doesn't leave a stale lease for the next run to report."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+        if remove:
+            try:
+                os.remove(self.lease_path(self.process_index))
+            except OSError:
+                pass
+
+
+def lease_dir(cfg) -> str:
+    """The rendezvous dir for a run: ``<model_file>.hb/`` — a sibling
+    of the checkpoint dir, on the same shared filesystem."""
+    return os.path.abspath(cfg.model_file) + ".hb"
+
+
+# --- the guard -----------------------------------------------------------
+#
+# Two failure shapes, two mechanisms, ONE caller surface
+# (guarded_collective):
+#
+# - A DEAD peer (SIGKILL, crash, node loss) resets the transport, so
+#   the collective RAISES on the calling thread within seconds (gloo:
+#   "Connection closed by peer"); the guard converts that to a
+#   WorkerLostError naming the stale lease holders. Inline — the
+#   calling thread keeps control, so elastic recovery can proceed.
+#
+# - A WEDGED peer (SIGSTOP, livelock) keeps its sockets open: the
+#   collective blocks INSIDE a C-level wait that Python cannot
+#   interrupt — no thread trick changes that, and dispatching jax
+#   programs from a helper thread to get a timeout is memory-unsafe in
+#   practice (observed heap corruption under the gloo CPU client). So
+#   the deadline is enforced by the lease's MONITOR thread instead:
+#   guarded_collective marks the call in-flight, and when the same
+#   call is still in flight past ``collective_timeout_seconds`` WITH
+#   stale peers on the table, the monitor emits the worker_lost
+#   diagnosis naming them, dumps stacks, and hard-exits with
+#   EXIT_WORKER_LOST — a diagnosed, bounded failure instead of an
+#   indefinite hang (a blocked main thread cannot run recovery code,
+#   so in-process shrink is only possible for the dead-peer shape; the
+#   supervisor restart plus the bounded bring-up retry owns the
+#   wedged shape).
+
+# Distinctive exit status for the monitor's escalation path: the
+# process was executed, the diagnosis is in the log/stream, and the
+# supervisor can tell "worker lost" from an ordinary crash.
+EXIT_WORKER_LOST = 86
+
+
+@dataclasses.dataclass
+class _GuardState:
+    lease: Optional[HeartbeatLease]
+    timeout_seconds: float
+    # (label, started_monotonic) of the collective currently blocking
+    # the calling thread; None between collectives. Tuple assignment —
+    # atomic under the GIL, read by the monitor thread.
+    in_flight: Optional[Tuple[str, float]] = None
+    # Monotonic time a guarded collective last COMPLETED (or the guard
+    # was armed). The lockstep protocol runs a guarded collective
+    # every step/window, so "none completed within the deadline"
+    # catches hangs that land in UNGUARDED sync points too — with
+    # async dispatch, a dead peer can surface as a block inside a
+    # device_put or result unpack rather than inside the wrapped call.
+    last_progress: float = 0.0
+    # Escalation hook (the monitor's hang verdict); tests inject a
+    # recorder instead of killing the test process.
+    escalate: Callable[[str], None] = None  # type: ignore[assignment]
+    warned_slow: bool = False
+
+
+_GUARD: Optional[_GuardState] = None
+
+
+def install_guard(lease: Optional[HeartbeatLease],
+                  timeout_seconds: float,
+                  escalate: Optional[Callable[[str], None]] = None
+                  ) -> Optional[_GuardState]:
+    """Arm guarded_collective() for this process (train/predict call
+    this once the cluster is up). Returns the previous state for
+    ``restore_guard`` — the same push/pop shape as telemetry's
+    active()."""
+    global _GUARD
+    prev = _GUARD
+    _GUARD = _GuardState(lease=lease,
+                         timeout_seconds=float(timeout_seconds),
+                         last_progress=time.monotonic(),
+                         escalate=escalate or _default_escalate)
+    return prev
+
+
+def restore_guard(prev: Optional[_GuardState]) -> None:
+    global _GUARD
+    _GUARD = prev
+
+
+def current_guard() -> Optional[_GuardState]:
+    return _GUARD
+
+
+def guarded_collective(fn: Callable, *args, label: str = "collective",
+                       **kwargs):
+    """Run a blocking collective under the process's deadline guard —
+    a HOST collective (process_allgather, broadcast, sync) or the
+    dispatch/fetch of a collective XLA program (the lockstep step and
+    score calls: on a dead cluster those block inside the program's
+    collectives exactly like a host allgather). With no guard
+    installed (single-process, or the knob off) this is a plain call —
+    zero behavior change. Armed:
+
+    - the call runs INLINE, marked in-flight for the monitor thread's
+      deadline check (see module comment above);
+    - a raise is re-raised, EXCEPT when the lease table shows dead
+      peers (a killed peer's transport reset surfaces as an opaque
+      RuntimeError/ValueError) — then a ``WorkerLostError`` naming
+      them, with the original error as ``__cause__``, after emitting
+      the ``health: worker_lost`` diagnosis;
+    - a call still blocked past ``collective_timeout_seconds`` with
+      stale peers is escalated by the monitor thread: diagnosis event,
+      stack dump, and a hard exit with ``EXIT_WORKER_LOST``.
+    """
+    state = _GUARD
+    if state is None:
+        return fn(*args, **kwargs)
+    state.in_flight = (label, time.monotonic())
+    try:
+        return fn(*args, **kwargs)
+    except WorkerLostError:
+        raise
+    except Exception as e:
+        _convert_if_peers_lost(state.lease, label, e)
+        raise
+    finally:
+        state.in_flight = None
+        state.last_progress = time.monotonic()
+        state.warned_slow = False
+
+
+def check_deadline(state: Optional[_GuardState] = None,
+                   now: Optional[float] = None) -> Optional[str]:
+    """One monitor tick of the collective deadline (called from the
+    lease's daemon loop; tests call it directly): when the in-flight
+    collective has exceeded ``collective_timeout_seconds``:
+
+    - stale peers on the lease table -> emit the ``health:
+      worker_lost`` diagnosis naming them, dump stacks, and invoke the
+      escalation hook (default: log a WorkerLostError-formatted
+      CRITICAL line and ``os._exit(EXIT_WORKER_LOST)``) — the blocked
+      thread can never raise, so a diagnosed bounded exit is the only
+      alternative to hanging forever;
+    - nobody stale -> a one-shot ``health: collective_slow`` warning
+      (a slow save/compile/storage stall must not kill a healthy
+      cluster).
+
+    Returns "escalated", "slow", or None for tests."""
+    state = state if state is not None else _GUARD
+    if state is None or state.timeout_seconds <= 0:
+        return None
+    now = time.monotonic() if now is None else now
+    snap = state.in_flight
+    if snap is not None:
+        label, started = snap
+        waited = now - started
+    else:
+        # No guarded call in flight, but none has COMPLETED within the
+        # deadline either: with async dispatch a dead peer can park
+        # the thread in an unguarded sync point (a device_put against
+        # a full queue, a result unpack) — the lockstep cadence of
+        # guarded collectives makes their absence the hang signal.
+        label = "no guarded collective completing"
+        waited = now - state.last_progress
+    if waited <= state.timeout_seconds:
+        return None
+    lease = state.lease
+    lost = lease.stale_peers() if lease is not None else []
+    if not lost:
+        if not state.warned_slow:
+            state.warned_slow = True
+            _emit_collective_slow(label, waited, state.timeout_seconds)
+        return "slow"
+    _emit_worker_lost(lost, label=label,
+                      timeout_seconds=state.timeout_seconds)
+    _dump_stacks(label)
+    who = "; ".join(i.describe() for i in lost)
+    message = (f"WorkerLostError: '{label}' exceeded "
+               f"collective_timeout_seconds="
+               f"{state.timeout_seconds:g}s; peers that stopped "
+               f"heartbeating: {who}. The blocked thread cannot be "
+               f"unblocked from Python; exiting {EXIT_WORKER_LOST} "
+               "with the diagnosis on the telemetry stream.")
+    state.escalate(message)
+    return "escalated"
+
+
+def _default_escalate(message: str) -> None:
+    import logging
+    logging.getLogger("fast_tffm_tpu").critical(message)
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is not None:
+        try:
+            tel.sink.flush()
+        except Exception:  # noqa: BLE001 - nothing left to do with a
+            pass           # broken sink on the way out
+    os._exit(EXIT_WORKER_LOST)
+
+
+def _await_staleness(lease: Optional[HeartbeatLease]
+                     ) -> List[PeerInfo]:
+    """Stale peers per the lease table, polling briefly: the guard's
+    deadline and a peer's lease crossing the staleness threshold are
+    independent clocks — give a freshly-dead peer up to one staleness
+    window to go visibly stale before concluding nobody died."""
+    if lease is None:
+        return []
+    deadline = time.monotonic() + lease.stale_after + lease.heartbeat_seconds
+    while True:
+        stale = lease.stale_peers()
+        if stale or time.monotonic() >= deadline:
+            return stale
+        time.sleep(min(0.05, max(lease.heartbeat_seconds / 4, 0.01)))
+
+
+# Error text that smells like the TRANSPORT failing (what a dead
+# peer's reset looks like through gloo/grpc/XLA), as opposed to a
+# semantic error (shape mismatch, OOM) the collective raised on its
+# own. Only transport-shaped errors are worth waiting a full staleness
+# window for — a genuine bug must re-raise promptly, not sit out a
+# ~25s grace poll on every worker.
+_TRANSPORT_ERROR_MARKERS = (
+    "connection", "unavailable", "socket", "gloo", "transport",
+    "deadline", "aborted", "cancelled", "coordination", "heartbeat",
+    "peer", "barrier",
+)
+
+
+def _looks_like_transport_error(cause: BaseException) -> bool:
+    text = f"{type(cause).__name__}: {cause}".lower()
+    return any(m in text for m in _TRANSPORT_ERROR_MARKERS)
+
+
+def _convert_if_peers_lost(lease: Optional[HeartbeatLease], label: str,
+                           cause: BaseException) -> None:
+    """Raise WorkerLostError (from ``cause``) when the lease table
+    blames a dead peer for a failed collective; return otherwise (the
+    caller re-raises the original). A transport-shaped error gets the
+    full staleness grace (a SIGKILLed peer's reset arrives long before
+    its lease crosses the threshold); any other error gets ONE
+    immediate lease check and re-raises without delay."""
+    if lease is not None and not _looks_like_transport_error(cause):
+        lost = lease.stale_peers()
+        if not lost:
+            return
+    else:
+        lost = _await_staleness(lease)
+    if not lost:
+        return
+    _emit_worker_lost(lost, label=label, error=f"{type(cause).__name__}: "
+                      f"{str(cause)[:200]}")
+    who = "; ".join(i.describe() for i in lost)
+    raise WorkerLostError(
+        f"collective '{label}' failed and the liveness table names "
+        f"dead peers: {who}", lost=lost) from cause
+
+
+def _emit_worker_lost(lost: Sequence[PeerInfo], label: str,
+                      timeout_seconds: Optional[float] = None,
+                      error: Optional[str] = None) -> None:
+    from fast_tffm_tpu.obs.health import emit_worker_lost
+    emit_worker_lost(lost, label=label, timeout_seconds=timeout_seconds,
+                     error=error)
+
+
+def _emit_collective_slow(label: str, waited: float,
+                          timeout_seconds: float) -> None:
+    """One-shot warning event: the collective exceeded its deadline
+    but EVERY peer is still heartbeating — a wedged-or-slow cluster,
+    not a shrunken one; never a reason to kill a healthy job."""
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is None:
+        return
+    tel.sink.emit("health", {
+        "status": "collective_slow",
+        "label": str(label),
+        "waited_seconds": round(float(waited), 3),
+        "timeout_seconds": float(timeout_seconds),
+    })
+    tel.sink.flush()
+
+
+def _dump_stacks(label: str) -> None:
+    """All-thread stacks beside the metrics file (same sidecar the
+    stall watchdog uses) — the 'where was everyone parked' answer for
+    the expired collective. Best-effort: no active telemetry, no
+    dump."""
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is None:
+        return
+    try:
+        path = tel.sink.path + ".stacks"
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(f"\n==== collective '{label}' deadline expired at "
+                     f"{time.time():.3f} ====\n")
+            fh.flush()
+            faulthandler.dump_traceback(file=fh, all_threads=True)
+    except Exception:  # noqa: BLE001 - forensics must never mask the
+        # WorkerLostError about to be raised
+        pass

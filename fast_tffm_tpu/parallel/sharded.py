@@ -324,7 +324,8 @@ LOCKSTEP_WINDOW = 8
 
 def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
                            table, uniq_bucket: int,
-                           max_batches: Optional[int] = None):
+                           max_batches: Optional[int] = None,
+                           preempt=None):
     """Drive a per-process batch iterator through a mesh score fn in
     LOCKSTEP: every score call is a collective program, so a process
     whose shard ran dry (or hit ``max_batches`` real batches) feeds
@@ -339,13 +340,23 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     — every round each process runs max(fills) collective programs,
     padding its own tail with fillers, so programs stay matched while
     the per-batch host-sync collective and the per-batch blocking score
-    fetch both amortize across the window."""
+    fetch both amortize across the window.
+
+    ``preempt`` (zero-arg callable, may be None): a per-process
+    preemption flag piggybacked on the fill allgather. A SIGTERM lands
+    on ONE worker; without this the signalled worker alone would stop
+    feeding collectives mid-sweep and desync the lockstep group — with
+    it, every process sees the flag in the SAME gathered result and
+    all stop together at the window boundary, before dispatching any
+    of that window's programs (the sweep ends early; train()'s
+    step-boundary save path then runs on every worker)."""
     import time as _time
     from jax.experimental import multihost_utils
     from fast_tffm_tpu.data.pipeline import empty_batch
     from fast_tffm_tpu.models.fm import batch_args
     from fast_tffm_tpu.obs.telemetry import active
     from fast_tffm_tpu.obs.trace import span
+    from fast_tffm_tpu.parallel.liveness import guarded_collective
     tel = active()  # per-worker lockstep telemetry (obs/): each
     # process counts its own rounds/fillers/examples into its own
     # sink shard; fmstat merges the streams keyed by process index
@@ -367,13 +378,25 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
                 window.append(b)
         # The silent multi-worker wait: a peer still filling (or hung)
         # parks everyone here. The span makes the wait VISIBLE on the
-        # timeline; if it never returns, the heartbeat below has gone
-        # quiet and the watchdog's stack dump names this allgather
-        # (obs/health.py).
+        # timeline; the deadline guard (parallel/liveness.py) bounds
+        # the wait — a dead peer raises WorkerLostError naming it
+        # instead of parking the cluster forever.
         with span("lockstep/allgather", window=len(window)):
-            fills = multihost_utils.process_allgather(
-                np.asarray([len(window)]))
-        rounds = int(fills.max())
+            flags = guarded_collective(
+                multihost_utils.process_allgather,
+                np.asarray([len(window),
+                            1 if (preempt is not None and preempt())
+                            else 0]),
+                label="lockstep/window_fill")
+        flags = np.asarray(flags).reshape(-1, 2)
+        if flags[:, 1].any():
+            # Coordinated preemption: every process computed the SAME
+            # gathered flags, so all return here together — no program
+            # of this window was dispatched, collectives stay matched.
+            if tel is not None:
+                tel.count("lockstep/preempted_windows")
+            return
+        rounds = int(flags[:, 0].max())
         if tel is not None:
             tel.heartbeat()  # a completed collective is progress
         if tel is not None and rounds:
@@ -405,7 +428,13 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
                     filler_gargs = global_batch(
                         mesh, len(filler.uniq_ids), **args)
                 gargs = filler_gargs
-            score = score_fn(table, **gargs)
+            # Collective program dispatch under the deadline guard: a
+            # dead peer parks the dispatch inside the program's own
+            # collectives, out of reach of the host-allgather guard
+            # above.
+            score = guarded_collective(score_fn, table,
+                                       label="lockstep/score_dispatch",
+                                       **gargs)
             if i < len(window):
                 pending.append((batch, score))
         n_real += len(window)
@@ -414,10 +443,14 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
                       sum(b.num_real for b in window))
         # Round-end bulk fetch: every queued score vector materializes
         # host-side here (the deferred D2H the window exists to
-        # amortize) — one span for the whole drain.
+        # amortize) — one span for the whole drain. Guarded: fetching
+        # a score whose producing program can never complete (dead
+        # peer mid-window) blocks exactly like the dispatch would.
         with span("lockstep/score_fetch", batches=len(pending)):
-            fetched = [(batch, local_rows(score))
-                       for batch, score in pending]
+            fetched = guarded_collective(
+                lambda: [(batch, local_rows(score))
+                         for batch, score in pending],
+                label="lockstep/score_fetch")
         for batch, local in fetched:
             # This process's rows of the global [B_global] score vector
             # are exactly its local batch (global_batch concatenates

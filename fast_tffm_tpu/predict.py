@@ -139,6 +139,28 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
     # a crash mid-sweep still flushes everything buffered.
     tel = make_telemetry(cfg, "predict")
     tel_prev = push_active(tel)
+    # Compute-plane liveness (parallel/liveness.py): multi-process
+    # predict is the same lockstep collective protocol as distributed
+    # validation — a dead peer must raise a named WorkerLostError, not
+    # park the survivors in the window allgather forever. No elastic
+    # recovery here (predict is cheap to rerun); fail fast with the
+    # diagnosis.
+    lease = None
+    guard_prev = None
+    guard_installed = False
+    if jax.process_count() > 1:
+        from fast_tffm_tpu.parallel.liveness import (HeartbeatLease,
+                                                     install_guard,
+                                                     lease_dir)
+        if cfg.heartbeat_seconds > 0:
+            lease = HeartbeatLease(
+                lease_dir(cfg), process_index=jax.process_index(),
+                members=range(jax.process_count()),
+                heartbeat_seconds=cfg.heartbeat_seconds).start()
+            if tel is not None:
+                tel.lease = lease
+        guard_prev = install_guard(lease, cfg.collective_timeout_seconds)
+        guard_installed = True
     try:
         written = _predict_body(cfg, table, logger)
         return written
@@ -146,6 +168,18 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
         # Crash forensics (obs/health.py): traceback + recent-event
         # ring as the stream's last substantive event; the finally
         # still closes the sink so run_end terminates the stream.
+        from fast_tffm_tpu.parallel.liveness import WorkerLostError
+        if isinstance(e, WorkerLostError):
+            # Fail fast with the diagnosis: drop buffered device
+            # scalars (their producing collectives will never
+            # complete) and retire the dead cluster's client so
+            # interpreter exit isn't stalled by a shutdown barrier
+            # that cannot succeed.
+            if tel is not None:
+                tel.sink.discard_scalars()
+            from fast_tffm_tpu.parallel.distributed import (
+                retire_distributed_client)
+            retire_distributed_client()
         if tel is not None:
             try:
                 tel.record_crash(e)
@@ -153,6 +187,14 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
                 logger.exception("crash event emission failed")
         raise
     finally:
+        if lease is not None:
+            try:
+                lease.stop()
+            except Exception:
+                logger.exception("heartbeat lease stop failed")
+        if guard_installed:
+            from fast_tffm_tpu.parallel.liveness import restore_guard
+            restore_guard(guard_prev)
         if tel is not None:
             try:
                 tel.close()
@@ -247,6 +289,7 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
     from jax.experimental import multihost_utils
     from fast_tffm_tpu.data.pipeline import (probe_uniq_bucket,
                                              require_bounded_examples)
+    from fast_tffm_tpu.parallel.liveness import guarded_collective
     from fast_tffm_tpu.parallel.sharded import (lockstep_score_batches,
                                                 make_mesh,
                                                 make_sharded_score_fn)
@@ -295,7 +338,9 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
             for v in vals:
                 fh.write(f"{v:.6f}\n")
         tag = os.path.basename(path)
-        multihost_utils.sync_global_devices(f"predict_parts_{tag}")
+        guarded_collective(multihost_utils.sync_global_devices,
+                           f"predict_parts_{tag}",
+                           label="predict/parts_barrier")
         if p == 0:
             n = 0
             # Stream the merge in bounded chunks: reading a whole part
@@ -313,7 +358,9 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
             logger.info("wrote %d scores to %s (merged %d parts)",
                         n, out_path, P)
         # Chief must finish reading every part before anyone deletes.
-        multihost_utils.sync_global_devices(f"predict_merged_{tag}")
+        guarded_collective(multihost_utils.sync_global_devices,
+                           f"predict_merged_{tag}",
+                           label="predict/merge_barrier")
         os.remove(part)
         written.append(out_path)
         if tel is not None:

@@ -23,6 +23,14 @@ Injectors (all restore global state on exit):
 - ``truncate_checkpoint`` — torn-write simulator: truncates one
   seeded-chosen array file inside the latest checkpoint step
   directory, for restore-error-path tests.
+- ``wait_until``          — bounded condition poll for the
+  compute-plane scenarios: the parent process delivers SIGKILL/SIGSTOP
+  to a worker only once an observable milestone (a committed
+  checkpoint step, a renewed heartbeat lease) proves the cluster is
+  mid-lockstep — deterministic in WHAT it waits for, never a bare
+  sleep.
+- ``committed_steps``     — the milestone reader ``wait_until`` pairs
+  with: committed checkpoint step numbers under ``<model_file>.ckpt``.
 
 No jax import at module level: the injectors patch pure-Python seams.
 """
@@ -35,7 +43,8 @@ import errno
 import os
 import random
 import signal
-from typing import Iterator, List, Optional
+import time
+from typing import Callable, Iterator, List, Optional
 
 # Corruption shapes that are malformed in EVERY parse mode (plain and
 # hash_feature_id, FM and FFM): a non-float label, and a non-float
@@ -125,6 +134,31 @@ def preempt_after_steps(n: int,
         yield state
     finally:
         StepTimer.tick = real_tick
+
+
+def wait_until(predicate: Callable[[], bool], timeout: float,
+               interval: float = 0.05,
+               message: str = "condition") -> None:
+    """Poll ``predicate`` until true or ``timeout`` seconds pass
+    (AssertionError naming ``message`` on expiry). The chaos
+    scenarios' trigger primitive: faults land at observable
+    milestones, not at wall-clock guesses."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out after {timeout:g}s waiting for "
+                         f"{message}")
+
+
+def committed_steps(model_file: str) -> List[int]:
+    """Committed checkpoint step numbers for ``model_file`` — the
+    milestone the multi-worker scenarios key fault delivery on (a
+    committed step proves every worker is past bring-up and stepping
+    in lockstep)."""
+    from fast_tffm_tpu.checkpoint import list_step_dirs
+    return list_step_dirs(os.path.abspath(model_file) + ".ckpt")
 
 
 def truncate_checkpoint(model_file: str, seed: int = 0,

@@ -118,7 +118,8 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                          uniq_bucket: int = 0,
                          max_batches: Optional[int] = None,
                          weight_files=(),
-                         bad_lines=None) -> Tuple[float, int]:
+                         bad_lines=None,
+                         preempt=None) -> Tuple[float, int]:
     """Multi-process sharded AUC: every process scores its own input
     shard through the mesh score fn in lockstep (the shared
     lockstep_score_batches protocol), then the per-process binned-AUC
@@ -128,10 +129,15 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
 
     ``uniq_bucket``: pass the caller's once-probed value; 0 re-probes
     (deterministic — same bytes on every process, so all agree without
-    a collective)."""
+    a collective). ``preempt`` rides the lockstep fill allgather
+    (parallel/sharded.py): a SIGTERM on one worker stops the sweep on
+    EVERY worker at the same window boundary — the partial histograms
+    still merge below (everyone exits the loop together, so the final
+    allgather stays matched)."""
     import numpy as np
     from jax.experimental import multihost_utils
     from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
+    from fast_tffm_tpu.parallel.liveness import guarded_collective
     from fast_tffm_tpu.parallel.sharded import (lockstep_score_batches,
                                                 make_sharded_score_fn)
     spec = ModelSpec.from_config(cfg)
@@ -146,7 +152,8 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                         bad_lines=bad_lines)
     for batch, local in lockstep_score_batches(cfg, it, mesh, score_fn,
                                                table, ub,
-                                               max_batches=max_batches):
+                                               max_batches=max_batches,
+                                               preempt=preempt):
         nr = batch.num_real
         auc.update(local[:nr], batch.labels[:nr], batch.weights[:nr])
         n += batch.num_real
@@ -162,8 +169,10 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                               np.asarray([n], np.float64)])
     hi = payload.astype(np.float32)
     lo = (payload - hi.astype(np.float64)).astype(np.float32)
-    gathered = multihost_utils.process_allgather(
-        np.stack([hi, lo]))                    # [P, 2, 2*bins+1] f32
+    gathered = guarded_collective(
+        multihost_utils.process_allgather,
+        np.stack([hi, lo]),
+        label="validation/auc_merge")          # [P, 2, 2*bins+1] f32
     gathered = gathered.reshape(-1, 2, 2 * bins + 1)
     vals = (gathered[:, 0, :].astype(np.float64)
             + gathered[:, 1, :].astype(np.float64)).sum(axis=0)
@@ -181,14 +190,186 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     ``job_name``/``task_index`` mirror the reference's ``dist_train``
     argv (SURVEY §3.2); in multi-process mode they identify this process
     in the jax.distributed cluster.
-    """
-    logger = get_logger(log_file=cfg.log_file or None)
-    shard_index, num_shards = 0, 1
-    if job_name is not None:
-        from fast_tffm_tpu.parallel.distributed import init_from_cluster
-        shard_index, num_shards = init_from_cluster(cfg, job_name,
-                                                    task_index or 0)
 
+    This is the elastic driver around ``_train_session`` (the actual
+    training loop): it owns run-scoped state that must SURVIVE a
+    compute-plane recovery — the telemetry stream (one run segment per
+    invocation, so worker_lost diagnoses and the recovery both land in
+    the same fmstat view), the bad-line tracker (quarantine dedupe
+    spans recoveries like it spans epochs), the heartbeat lease, and
+    the collective deadline guard. On ``WorkerLostError`` with
+    ``elastic = shrink`` the survivors tear the distributed client
+    down, reform the cluster from the surviving lease holders
+    (``reform_shrunken_cluster``), and re-enter the session — which
+    restores from the last verified checkpoint and redistributes the
+    lost worker's input shards by re-sharding over the shrunken
+    membership. With ``elastic = off`` the error (naming the dead
+    peers) propagates: fail fast, never hang."""
+    from fast_tffm_tpu.parallel.liveness import (
+        HeartbeatLease, WorkerLostError, install_guard, lease_dir,
+        restore_guard)
+    logger = get_logger(log_file=cfg.log_file or None)
+    # Telemetry BEFORE the cluster join, keyed by the launcher-assigned
+    # task index (jax.process_index() is not valid yet): a job that
+    # never forms still writes its `health: cluster_bringup_failed`
+    # post-mortem into the stream, and elastic recoveries later stay
+    # inside this one run segment.
+    tel = make_telemetry(cfg, "train",
+                         process_index=(task_index or 0)
+                         if job_name is not None else None,
+                         process_count=max(len(cfg.worker_hosts), 1)
+                         if job_name is not None else None)
+    if tel is not None:
+        logger.info(
+            "writing run metrics to %s (flush every %s steps; summarize "
+            "with: python -m tools.fmstat %s)", tel.sink.path,
+            tel.flush_steps or "epoch", tel.sink.path)
+    # One run-scoped tracker (None under bad_line_policy = error): the
+    # max_bad_fraction breaker and the quarantine dedupe must see the
+    # WHOLE run — every epoch AND every elastic recovery
+    # (data/badlines.py).
+    bad_tracker = BadLineTracker.from_config(cfg)
+    tel_prev = push_active(tel)  # popped in the finally, crash or not
+    lease = None
+    guard_prev = None
+    guard_installed = False
+    try:
+        shard_index, num_shards = 0, 1
+        if job_name is not None:
+            from fast_tffm_tpu.parallel.distributed import init_from_cluster
+            shard_index, num_shards = init_from_cluster(cfg, job_name,
+                                                        task_index or 0)
+            if tel is not None:
+                # The meta was stamped pre-join with the LOCAL backend
+                # view (deliberate: bring-up failures must land in the
+                # stream); refresh it in place so every subsequent
+                # event's `run` field carries the real topology.
+                tel.sink.meta.update(
+                    backend=jax.default_backend(),
+                    device_count=jax.device_count(),
+                    process_count=jax.process_count())
+        if num_shards > 1 and cfg.heartbeat_seconds > 0:
+            lease = HeartbeatLease(
+                lease_dir(cfg), process_index=shard_index,
+                members=range(num_shards),
+                heartbeat_seconds=cfg.heartbeat_seconds).start()
+            if tel is not None:
+                tel.lease = lease
+        if num_shards > 1:
+            guard_prev = install_guard(
+                lease, cfg.collective_timeout_seconds)
+            guard_installed = True
+        generation = 0
+        while True:
+            try:
+                return _train_session(cfg, logger, tel, bad_tracker,
+                                      shard_index, num_shards)
+            except WorkerLostError as e:
+                if (cfg.elastic != "shrink" or num_shards <= 1
+                        or lease is None):
+                    _record_crash(tel, logger, e)
+                    # Fail FAST: retire (never shutdown — its barrier
+                    # cannot complete with a dead peer) so interpreter
+                    # exit isn't stalled by the doomed handshake.
+                    from fast_tffm_tpu.parallel.distributed import (
+                        retire_distributed_client)
+                    retire_distributed_client()
+                    raise
+                generation += 1
+                logger.warning(
+                    "worker lost (%s); elastic shrink recovery, "
+                    "cluster generation %d", e, generation)
+                lost_ids = sorted({i.process_index for i in e.lost})
+                # Disarm the deadline sentinel for the reform: no
+                # guarded collective completes while the cluster is
+                # down, and the dead peer stays stale — the sentinel
+                # would otherwise read the (healthy, bounded) reform
+                # as a hang and hard-exit mid-recovery.
+                if guard_installed:
+                    restore_guard(guard_prev)
+                    guard_installed = False
+                from fast_tffm_tpu.parallel.distributed import (
+                    reform_shrunken_cluster)
+                try:
+                    shard_index, num_shards, members = \
+                        reform_shrunken_cluster(cfg, lease, generation,
+                                                logger)
+                except BaseException as re:
+                    _record_crash(tel, logger, re)
+                    raise
+                from fast_tffm_tpu.obs.health import emit_elastic_recovery
+                emit_elastic_recovery(generation, members, lost_ids)
+                logger.info(
+                    "elastic recovery complete: %d survivor(s), input "
+                    "shards redistributed, resuming from the last "
+                    "verified checkpoint", num_shards)
+                if num_shards > 1:
+                    # Re-arm for the shrunken cluster (the lease's
+                    # expected membership was updated by the reform).
+                    guard_prev = install_guard(
+                        lease, cfg.collective_timeout_seconds)
+                    guard_installed = True
+                else:
+                    # Lone survivor: no peers left to guard against;
+                    # stop the lease so the next multi-worker run in
+                    # this rendezvous dir starts from a clean table.
+                    lease.stop()
+                    if tel is not None:
+                        tel.lease = None
+                    lease = None
+    except BaseException as e:
+        # Crash forensics for everything the session didn't already
+        # record (it records its own loop crashes with the step
+        # attached; WorkerLostError and reform failures are recorded
+        # above). record_crash is idempotent per event stream read —
+        # but avoid double events: only record here if the session
+        # never did (it marks recorded exceptions).
+        if tel is not None and not getattr(e, "_fm_crash_recorded",
+                                           False):
+            _record_crash(tel, logger, e)
+        raise
+    finally:
+        if lease is not None:
+            try:
+                lease.stop()
+            except Exception:
+                logger.exception("heartbeat lease stop failed")
+        if guard_installed:
+            restore_guard(guard_prev)
+        if tel is not None:
+            try:
+                tel.close()
+            except Exception:
+                logger.exception("metrics sink close failed")
+        if bad_tracker is not None:
+            try:
+                bad_tracker.close()
+            except Exception:
+                logger.exception("quarantine file close failed")
+        pop_active(tel_prev)
+
+
+def _record_crash(tel, logger, e: BaseException, step: int = -1) -> None:
+    """Best-effort crash event, marking the exception so the outer
+    driver doesn't write it twice."""
+    if tel is None or getattr(e, "_fm_crash_recorded", False):
+        return
+    try:
+        tel.record_crash(e, step)
+        e._fm_crash_recorded = True
+    except Exception:
+        logger.exception("crash event emission failed")
+
+
+def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
+                   shard_index: int, num_shards: int) -> jax.Array:
+    """One training session against the CURRENT cluster membership:
+    mesh build, checkpoint restore, the epoch/step loop, and the final
+    save/export. Raises ``WorkerLostError`` out of any guarded
+    collective when a peer dies — the elastic driver (``train``) owns
+    what happens next. Everything created here (checkpoint manager,
+    summaries, signal handlers, profiler) is torn down here, so the
+    driver can safely re-enter after a recovery."""
     spec = ModelSpec.from_config(cfg)
     multi_process = jax.process_count() > 1
     offload = cfg.lookup == "host"
@@ -228,20 +409,10 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             "paths rely on the host-side unique contract (fixed-U "
             "buckets, global_batch local_idx offsets)")
 
-    # Run telemetry (obs/; metrics_file knob): counters/gauges/
-    # histograms flushed as JSONL. Every process writes its own shard
-    # file; device scalars (loss) buffer and bulk-fetch only at epoch
-    # barriers — same link-safety discipline as summaries/log_buffer.
-    # Created BEFORE the input probe / checkpoint restore / offload
-    # bring-up so setup is inside the stream too: a run wedged
-    # restoring against dead storage stalls the watchdog, and a setup
-    # crash still writes its crash event (obs/health.py forensics).
-    tel = make_telemetry(cfg, "train")
-    if tel is not None:
-        logger.info(
-            "writing run metrics to %s (flush every %s steps; summarize "
-            "with: python -m tools.fmstat %s)", tel.sink.path,
-            tel.flush_steps or "epoch", tel.sink.path)
+    # Run telemetry (tel) and the bad-line tracker arrive from the
+    # elastic driver (train()): both are run-scoped — they must span
+    # every session a recovery re-enters, so the driver owns their
+    # lifecycle and this session only feeds them.
     # Names the finally below reads; they must exist even when setup
     # raises before reaching their real definitions.
     summaries = None
@@ -249,15 +420,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     prev_handlers = {}
     global_step = 0
     ckpt = None
-    # One run-scoped tracker (None under bad_line_policy = error): the
-    # max_bad_fraction breaker and the quarantine dedupe must see the
-    # WHOLE run, not one epoch's iterator (data/badlines.py).
-    bad_tracker = BadLineTracker.from_config(cfg)
 
     def flush_log():  # rebound once the deferred log buffer exists
         pass
 
-    tel_prev = push_active(tel)  # popped in the finally, crash or not
+    worker_lost = False
     try:
         uniq_bucket = 0
         if multi_process:
@@ -524,10 +691,17 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     # would hang the cluster. Agree on exhaustion/
                     # preemption each step (tiny host allgather) and feed
                     # all-padding filler batches (zero weight -> zero
-                    # loss/grad) until everyone is done.
+                    # loss/grad) until everyone is done. The deadline
+                    # guard bounds the wait: a dead peer raises
+                    # WorkerLostError naming it instead of parking the
+                    # survivors here forever (parallel/liveness.py).
                     from jax.experimental import multihost_utils
-                    flags = multihost_utils.process_allgather(
-                        np.asarray([batch is None, bool(preempted)]))
+                    from fast_tffm_tpu.parallel.liveness import (
+                        guarded_collective)
+                    flags = guarded_collective(
+                        multihost_utils.process_allgather,
+                        np.asarray([batch is None, bool(preempted)]),
+                        label="train/step_flags")
                     if bool(flags[..., 1].any()):
                         stopping = True
                         logger.info(
@@ -586,7 +760,21 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                             else contextlib.nullcontext())
                 with span("train/step", step=global_step + 1):
                     with prof_ann:
-                        table, acc, loss, _ = step_fn(table, acc, **args)
+                        if multi_process:
+                            # The sharded step IS a collective program:
+                            # on a dead cluster its dispatch blocks
+                            # inside the program's collectives exactly
+                            # like a host allgather (pinned by the
+                            # hang-worker chaos stack dumps), so it
+                            # runs under the same deadline guard.
+                            from fast_tffm_tpu.parallel.liveness import (
+                                guarded_collective)
+                            table, acc, loss, _ = guarded_collective(
+                                step_fn, table, acc,
+                                label="train/step_dispatch", **args)
+                        else:
+                            table, acc, loss, _ = step_fn(table, acc,
+                                                          **args)
                 global_step += 1
                 last_val = None  # table advanced; any cached AUC is stale
                 n_global = batch.num_real * (jax.process_count()
@@ -684,9 +872,14 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 # and deadlock the collective program), and every
                 # process applies the same doubling.
                 from jax.experimental import multihost_utils
-                tot = multihost_utils.process_allgather(np.asarray(
-                    [epoch_stats.spilled_batches, epoch_stats.batches,
-                     epoch_stats.max_uniq]))
+                from fast_tffm_tpu.parallel.liveness import (
+                    guarded_collective)
+                tot = guarded_collective(
+                    multihost_utils.process_allgather,
+                    np.asarray(
+                        [epoch_stats.spilled_batches, epoch_stats.batches,
+                         epoch_stats.max_uniq]),
+                    label="train/spill_stats")
                 tot = tot.reshape(-1, 3)
                 # fmlint: disable=R001 -- tot is the HOST numpy result
                 # of process_allgather; these ints never touch a device
@@ -702,12 +895,20 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 vmb = cfg.validation_max_batches or None
                 with span("train/validation", epoch=epoch):
                     if multi_process:
+                        # preempt rides the lockstep window allgather:
+                        # a SIGTERM during a long validation sweep
+                        # stops EVERY worker at the same window
+                        # boundary (the signalled worker alone bailing
+                        # would desync the collective program stream);
+                        # the step loop below then drains the flag and
+                        # all workers save together.
                         auc, n = evaluate_distributed(
                             cfg, table, cfg.validation_files, mesh,
                             shard_index, num_shards,
                             uniq_bucket=val_bucket, max_batches=vmb,
                             weight_files=cfg.validation_weight_files,
-                            bad_lines=bad_tracker)
+                            bad_lines=bad_tracker,
+                            preempt=lambda: bool(preempted))
                     else:
                         auc, n = evaluate(
                             cfg, table, cfg.validation_files,
@@ -793,56 +994,84 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                            vocabulary_size=cfg.vocabulary_size)
     except BaseException as e:
         # Crash forensics: the stream's last substantive event carries
-        # the traceback and the recent-event ring, written before the
-        # finally closes the sink (so run_end still terminates the
-        # stream). Never let forensics mask the real error.
-        if tel is not None:
-            try:
-                tel.record_crash(e, global_step)
-            except Exception:
-                logger.exception("crash event emission failed")
+        # the traceback and the recent-event ring, with the step
+        # attached. A WorkerLostError is NOT a crash yet — the elastic
+        # driver may recover it; the driver records it if it decides
+        # to re-raise instead.
+        from fast_tffm_tpu.parallel.liveness import WorkerLostError
+        worker_lost = isinstance(e, WorkerLostError)
+        if not worker_lost:
+            _record_crash(tel, logger, e, global_step)
         raise
     finally:
         try:
-            # Checkpoint lifecycle on ALL exit paths: an exception (or
-            # preemption) between the last periodic save and the normal
-            # close must not leave an async save in flight — the
-            # process would exit mid-write and tear the newest step.
-            # close() waits for the in-flight write, settles the owed
-            # integrity manifest, and releases the manager; isolated so
-            # a failed close can't starve the sink drains below.
-            if ckpt is not None:
+            if worker_lost:
+                # HOST-ONLY teardown: a peer is dead, so any device
+                # fetch (buffered loss scalars, TB summaries, the
+                # deferred log buffer — all outputs of collective
+                # programs that will never complete) and any orbax
+                # multi-host commit barrier (ckpt.close) can block
+                # forever — the exact hang the deadline guard just
+                # escaped. Drop the device-side buffers (counted, not
+                # silent), flush host events, and let the elastic
+                # driver rebuild the checkpoint manager; the verified
+                # restore walk-back owns anything torn.
+                if tel is not None:
+                    try:
+                        dropped = tel.sink.discard_scalars()
+                        if dropped:
+                            tel.count("cluster/scalars_dropped", dropped)
+                        tel.sink.flush()
+                    except Exception:
+                        logger.exception("host-only metrics flush "
+                                         "failed")
+                logger.warning(
+                    "worker lost: skipped checkpoint close and "
+                    "device-scalar drains (device fetches could hang "
+                    "on the dead peer's collectives)")
+            else:
+                # Checkpoint lifecycle on ALL normal exit paths: an
+                # exception (or preemption) between the last periodic
+                # save and the normal close must not leave an async
+                # save in flight — the process would exit mid-write
+                # and tear the newest step. close() waits for the
+                # in-flight write, settles the owed integrity
+                # manifest, and releases the manager; isolated so a
+                # failed close can't starve the sink drains below.
+                if ckpt is not None:
+                    try:
+                        ckpt.close()
+                    except Exception:
+                        logger.exception("checkpoint close failed")
+                # Sink lifecycle on error paths: a crash mid-epoch
+                # must not drop everything buffered since the last
+                # flush — the log buffer and the TensorBoard scalars
+                # drain here, each isolated so one broken writer can't
+                # starve the others. (The metrics sink and bad-line
+                # tracker are DRIVER-scoped: they survive elastic
+                # recoveries and close in train().)
                 try:
-                    ckpt.close()
+                    flush_log()
                 except Exception:
-                    logger.exception("checkpoint close failed")
-            # Sink lifecycle on error paths: a crash mid-epoch must not
-            # drop everything buffered since the last flush — the log
-            # buffer, the TensorBoard scalars, and the metrics sink all
-            # drain here, each isolated so one broken writer can't
-            # starve the others.
-            try:
-                flush_log()
-            except Exception:
-                logger.exception("deferred loss-log flush failed")
-            if summaries is not None:
-                # Buffered scalars must reach the event file even when
-                # the loop raised or a preemption cut the final epoch.
-                try:
-                    summaries.close()
-                except Exception:
-                    logger.exception("summary writer close failed")
-            if tel is not None:
-                try:
-                    tel.close(global_step)
-                except Exception:
-                    logger.exception("metrics sink close failed")
-            if bad_tracker is not None:
-                try:
-                    bad_tracker.close()
-                except Exception:
-                    logger.exception("quarantine file close failed")
-            pop_active(tel_prev)
+                    logger.exception("deferred loss-log flush failed")
+                if summaries is not None:
+                    # Buffered scalars must reach the event file even
+                    # when the loop raised or a preemption cut the
+                    # final epoch.
+                    try:
+                        summaries.close()
+                    except Exception:
+                        logger.exception("summary writer close failed")
+                if tel is not None:
+                    try:
+                        # Barrier, not close: buffered device scalars
+                        # and the final counter snapshot reach disk
+                        # with this session's step attached, and the
+                        # stream stays open for a recovered session to
+                        # continue.
+                        tel.barrier_flush(global_step)
+                    except Exception:
+                        logger.exception("metrics barrier flush failed")
             if profiling:
                 # Window ran past the end of training — or the loop
                 # raised with the window open; either way the trace must
@@ -940,6 +1169,7 @@ def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
     already validated this exact table, re-sweeping validation_files
     (every batch a collective) would just recompute it."""
     from jax.experimental import multihost_utils
+    from fast_tffm_tpu.parallel.liveness import guarded_collective
     if cfg.validation_files:
         if last_val is None:  # e.g. preemption cut the epoch short
             # Same cap as the per-epoch sweeps: an uncapped fallback
@@ -974,14 +1204,16 @@ def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
                if chief else None)
         for a in range(0, cfg.num_rows, chunk):
             b = min(a + chunk, cfg.num_rows)
-            piece = multihost_utils.process_allgather(table[a:b],
-                                                      tiled=True)
+            piece = guarded_collective(
+                multihost_utils.process_allgather, table[a:b],
+                tiled=True, label="finalize/export_chunk")
             if chief:
                 out[a:b] = np.asarray(piece)
         if chief:
             export_npz(out, cfg.model_file + ".npz",
                        vocabulary_size=cfg.vocabulary_size)
-    multihost_utils.sync_global_devices("fast_tffm_tpu_finalize")
+    guarded_collective(multihost_utils.sync_global_devices,
+                       "fast_tffm_tpu_finalize", label="finalize/sync")
 
 
 def ckpt_state(cfg: FmConfig, table: jax.Array, acc: jax.Array):

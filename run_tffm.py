@@ -118,5 +118,40 @@ def main(argv=None) -> int:
     return 0
 
 
+def _exit(rc: int) -> "None":
+    """sys.exit, EXCEPT after a run that retired a dead cluster's
+    jax.distributed client (elastic recovery / WorkerLostError fail
+    fast): normal interpreter teardown destroys the retired
+    coordination service, whose call cancellation trips the retired
+    client's fatal error handler — a SIGABRT after an otherwise clean
+    exit. Every durable artifact (checkpoint, metrics stream, logs,
+    exports) is already closed by the drivers' finally blocks, so
+    skipping C++ teardown of dead cluster plumbing via os._exit is the
+    correct last step."""
+    try:
+        from fast_tffm_tpu.parallel.distributed import has_retired_clients
+        retired = has_retired_clients()
+    except Exception:
+        retired = False
+    if retired:
+        import logging
+        logging.shutdown()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+    sys.exit(rc)
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        rc = main()
+    except SystemExit as e:  # preserve explicit exit codes
+        _exit(e.code if isinstance(e.code, int) else (0 if e.code is
+                                                      None else 1))
+    except KeyboardInterrupt:
+        raise  # standard ^C semantics (exit 130), not a failure exit
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        _exit(1)
+    _exit(rc)

@@ -102,3 +102,50 @@ def test_zero_budget_never_calls_initialize():
                               process_id=0, timeout_seconds=0.0,
                               sleep=clock.sleep,
                               clock=lambda: clock.t + 1.0)
+
+
+def test_exhaustion_emits_cluster_bringup_failed_event(tmp_path):
+    """ISSUE 6 satellite: exhaustion writes a ``health:
+    cluster_bringup_failed`` event to the telemetry stream BEFORE
+    raising — a job that never formed must be visible to fmstat
+    post-mortems, not just to whoever read the process's stderr."""
+    import json
+    from fast_tffm_tpu.obs.telemetry import RunTelemetry, activate
+    clock = FakeClock()
+
+    def init(**kw):
+        raise RuntimeError("UNAVAILABLE: connect refused")
+
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+    with activate(tel):
+        with pytest.raises(RuntimeError):
+            initialize_with_retry(
+                init, address="coord:9476", num_processes=4,
+                process_id=2, timeout_seconds=10.0, sleep=clock.sleep,
+                clock=clock)
+    tel.close()
+    with open(path) as fh:
+        events = [json.loads(ln) for ln in fh if ln.strip()]
+    fails = [e for e in events
+             if e.get("status") == "cluster_bringup_failed"]
+    assert len(fails) == 1
+    assert fails[0]["coordinator"] == "coord:9476"
+    assert fails[0]["process_index"] == 2
+    assert fails[0]["attempts"] >= 1
+    assert "UNAVAILABLE" in fails[0]["error"]
+    # counted too, so fmstat's merged counters surface it
+    metrics = [e for e in events if e.get("event") == "metrics"]
+    assert metrics[-1]["counters"]["cluster/bringup_failures"] == 1
+
+
+def test_exhaustion_without_telemetry_still_raises():
+    clock = FakeClock()
+
+    def init(**kw):
+        raise RuntimeError("DEADLINE_EXCEEDED")
+
+    with pytest.raises(RuntimeError, match="failed to join"):
+        initialize_with_retry(init, address="h:1", num_processes=2,
+                              process_id=1, timeout_seconds=5.0,
+                              sleep=clock.sleep, clock=clock)

@@ -328,3 +328,73 @@ def test_r005_respects_pragma(tmp_path):
             shutil.rmtree(ckpt_dir)
     """)
     assert run_file(path) == []
+
+
+def _parallel_file(tmp_path, body, name="sharded.py"):
+    """Write ``body`` at a path inside R006's cluster-critical scope."""
+    d = tmp_path / "fast_tffm_tpu" / "parallel"
+    d.mkdir(parents=True, exist_ok=True)
+    p = d / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_r006_flags_bare_collectives(tmp_path):
+    """ISSUE 6 satellite: a bare blocking collective outside
+    guarded_collective() in a cluster-critical module is the
+    hang-forever-on-a-dead-peer failure mode."""
+    path = _parallel_file(tmp_path, """\
+        from jax.experimental import multihost_utils
+        def sync(x):
+            fills = multihost_utils.process_allgather(x)
+            v = multihost_utils.broadcast_one_to_all(x)
+            multihost_utils.sync_global_devices("tag")
+            return fills, v
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R006", "R006", "R006"]
+    assert "guarded_collective" in found[0].message
+
+
+def test_r006_allows_passing_collective_as_argument(tmp_path):
+    """The guarded form REFERENCES the collective without calling it —
+    that must not be a finding, or the fix itself would be flagged."""
+    path = _parallel_file(tmp_path, """\
+        from jax.experimental import multihost_utils
+        from fast_tffm_tpu.parallel.liveness import guarded_collective
+        def sync(x):
+            return guarded_collective(
+                multihost_utils.process_allgather, x, label="x")
+    """)
+    assert run_file(path) == []
+
+
+def test_r006_scope(tmp_path):
+    body = """\
+        from jax.experimental import multihost_utils
+        def sync(x):
+            return multihost_utils.process_allgather(x)
+    """
+    # checkpoint.py and train.py are in scope...
+    d = tmp_path / "fast_tffm_tpu"
+    d.mkdir(exist_ok=True)
+    for name in ("checkpoint.py", "train.py"):
+        p = d / name
+        p.write_text(textwrap.dedent(body))
+        assert [f.rule for f in run_file(str(p))] == ["R006"], name
+    # ...the guard's own implementation and non-cluster modules are not
+    assert run_file(_parallel_file(tmp_path, body,
+                                   name="liveness.py")) == []
+    other = d / "metrics.py"
+    other.write_text(textwrap.dedent(body))
+    assert run_file(str(other)) == []
+
+
+def test_r006_respects_pragma(tmp_path):
+    path = _parallel_file(tmp_path, """\
+        from jax.experimental import multihost_utils
+        def sync(x):
+            # fmlint: disable=R006 -- bring-up path, no guard yet
+            return multihost_utils.process_allgather(x)
+    """)
+    assert run_file(path) == []

@@ -1,0 +1,498 @@
+"""Compute-plane fault tolerance unit tests (parallel/liveness.py +
+the fmstat DEGRADED surface): heartbeat-lease staleness math and the
+collective deadline guard under fake clocks — no real multi-process
+spawn (the end-to-end legs live in the fmchaos kill-worker-midwindow /
+hang-worker scenarios)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from fast_tffm_tpu.obs.attribution import (health_verdict, summarize,
+                                           worker_table)
+from fast_tffm_tpu.obs.telemetry import RunTelemetry, activate
+from fast_tffm_tpu.parallel import liveness as lv
+from fast_tffm_tpu.parallel.liveness import (HeartbeatLease, PeerInfo,
+                                             WorkerLostError,
+                                             check_deadline,
+                                             guarded_collective,
+                                             install_guard,
+                                             restore_guard)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture
+def guard_teardown():
+    """Whatever a test installs, the process-global guard is clean
+    after — a leaked guard would silently wrap unrelated tests'
+    collectives."""
+    yield
+    restore_guard(None)
+
+
+def _lease(tmp_path, clock, index=0, members=(0, 1),
+           hb=5.0) -> HeartbeatLease:
+    return HeartbeatLease(str(tmp_path / "hb"), process_index=index,
+                          members=members, heartbeat_seconds=hb,
+                          host=f"host{index}", pid=100 + index,
+                          clock=clock)
+
+
+def _events(path):
+    with open(path) as fh:
+        return [json.loads(ln) for ln in fh if ln.strip()]
+
+
+# --- lease staleness math -------------------------------------------------
+
+
+def test_missing_lease_reads_as_lost(tmp_path):
+    clock = FakeClock()
+    lease = _lease(tmp_path, clock)
+    lease.renew()
+    stale = lease.stale_peers()
+    assert [p.process_index for p in stale] == [1]
+    assert stale[0].age_seconds is None  # never wrote a lease
+    assert "no lease on disk" in stale[0].describe()
+
+
+def test_staleness_threshold_math(tmp_path):
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0)
+    peer = _lease(tmp_path, clock, index=1)
+    me.renew()
+    peer.renew()
+    assert me.stale_peers() == []
+    # stale_after defaults to 4 heartbeats = 20s here: 19s fresh,
+    # 21s stale.
+    clock.t += 19.0
+    assert me.stale_peers() == []
+    clock.t += 2.0
+    stale = me.stale_peers()
+    assert [p.process_index for p in stale] == [1]
+    assert stale[0].age_seconds == pytest.approx(21.0)
+    assert stale[0].host == "host1"
+    # our OWN lease is never reported (the monitor runs in-process)
+    assert all(p.process_index != 0 for p in stale)
+
+
+def test_lease_renewal_races_staleness_check(tmp_path):
+    """A peer that renews between two checks must drop off the stale
+    list — staleness is re-evaluated from the file every time, never
+    latched."""
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0)
+    peer = _lease(tmp_path, clock, index=1)
+    me.renew()
+    peer.renew()
+    clock.t += 30.0
+    assert [p.process_index for p in me.stale_peers()] == [1]
+    peer.renew()  # the "race": renewal lands right after a check
+    assert me.stale_peers() == []
+    assert me.live_members() == [0, 1]
+
+
+def test_live_members_and_shrunken_membership(tmp_path):
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0, members=(0, 1, 2))
+    p2 = _lease(tmp_path, clock, index=2, members=(0, 1, 2))
+    me.renew()
+    p2.renew()
+    clock.t += 30.0
+    me.renew()
+    p2.renew()
+    assert me.live_members() == [0, 2]  # 1 never wrote a lease
+    # elastic reform shrinks the expected membership: 1 stops being
+    # reported lost forever after
+    me.members = (0, 2)
+    assert me.stale_peers() == []
+
+
+def test_check_peers_one_event_per_episode(tmp_path):
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0)
+    peer = _lease(tmp_path, clock, index=1)
+    me.renew()
+    peer.renew()
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+    with activate(tel):
+        clock.t += 30.0
+        assert [p.process_index for p in me.check_peers()] == [1]
+        assert me.check_peers() == []  # same episode: no second event
+        peer.renew()                   # recovery re-arms
+        assert me.check_peers() == []
+        clock.t += 30.0
+        assert [p.process_index for p in me.check_peers()] == [1]
+    tel.close()
+    lost = [e for e in _events(path)
+            if e.get("event") == "health"
+            and e.get("status") == "worker_lost"]
+    assert len(lost) == 2
+    assert lost[0]["lost"][0]["process_index"] == 1
+    assert lost[0]["lost"][0]["host"] == "host1"
+
+
+def test_torn_lease_file_reads_as_never_heard(tmp_path):
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0)
+    me.renew()
+    (tmp_path / "hb" / "worker-1.hb").write_text("{torn")
+    stale = me.stale_peers()
+    assert [p.process_index for p in stale] == [1]
+    assert stale[0].age_seconds is None
+
+
+def test_reform_announcements(tmp_path):
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0, members=(0, 1, 2))
+    p2 = _lease(tmp_path, clock, index=2, members=(0, 1, 2))
+    me.announce_reform(1)
+    p2.announce_reform(1)
+    assert me.reform_members(1) == [0, 2]
+    assert me.reform_members(2) == []  # per-generation files
+
+
+def test_stop_removes_own_lease(tmp_path):
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0)
+    me.renew()
+    assert me.read(0) is not None
+    me.stop()
+    assert me.read(0) is None
+
+
+# --- guarded_collective: inline conversion --------------------------------
+
+
+def test_no_guard_is_plain_call(guard_teardown):
+    assert guarded_collective(lambda a, b: a + b, 1, 2) == 3
+
+
+def test_exception_converts_when_peer_dead(tmp_path, guard_teardown):
+    clock = FakeClock()
+    lease = _lease(tmp_path, clock, index=0, hb=0.01)
+    lease.renew()  # peer 1 never does; tiny hb -> tiny staleness grace
+    install_guard(lease, 30.0)
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+
+    def boom():
+        raise RuntimeError("Gloo AllGather failed: connection closed")
+
+    with activate(tel):
+        with pytest.raises(WorkerLostError) as ei:
+            guarded_collective(boom, label="lockstep/window_fill")
+    tel.close()
+    assert "process 1" in str(ei.value)
+    assert "lockstep/window_fill" in str(ei.value)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    assert [p.process_index for p in ei.value.lost] == [1]
+    events = [e for e in _events(path)
+              if e.get("status") == "worker_lost"]
+    assert events and events[0]["lost"][0]["process_index"] == 1
+
+
+def test_exception_reraised_when_everyone_alive(tmp_path,
+                                                guard_teardown):
+    # real clocks: the conversion's staleness grace actually polls
+    # (~1s bounded by stale_after + one heartbeat at hb=0.2)
+    me = HeartbeatLease(str(tmp_path / "hb"), process_index=0,
+                        members=(0, 1), heartbeat_seconds=0.2)
+    peer = HeartbeatLease(str(tmp_path / "hb"), process_index=1,
+                          members=(0, 1), heartbeat_seconds=0.2)
+    me.renew()
+    peer.start()  # live renew thread: stays fresh through the
+    # conversion's grace poll
+    install_guard(me, 30.0)
+
+    def boom():
+        raise ValueError("not a peer problem")
+
+    try:
+        with pytest.raises(ValueError, match="not a peer problem"):
+            guarded_collective(boom, label="x")
+    finally:
+        peer.stop()
+
+
+def test_worker_lost_error_passes_through_unwrapped(tmp_path,
+                                                    guard_teardown):
+    lease = _lease(tmp_path, FakeClock(), index=0)
+    install_guard(lease, 30.0)
+    original = WorkerLostError("already diagnosed",
+                               lost=[PeerInfo(3, host="h3")])
+
+    def reraise():
+        raise original
+
+    with pytest.raises(WorkerLostError) as ei:
+        guarded_collective(reraise, label="x")
+    assert ei.value is original
+
+
+# --- the deadline sentinel ------------------------------------------------
+
+
+def test_deadline_fires_before_collective_returns(tmp_path,
+                                                  guard_teardown):
+    """The acceptance shape: a guarded collective is STILL BLOCKED
+    when the monitor's deadline check runs — the check must escalate
+    with the named diagnosis while the call sits in flight, not wait
+    for it to return."""
+    clock = FakeClock()
+    lease = _lease(tmp_path, clock, index=0)
+    lease.renew()  # peer 1 stale (never wrote)
+    hits = []
+    install_guard(lease, 0.2, escalate=hits.append)
+    release = threading.Event()
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+
+    def blocked_collective():
+        release.wait(10)
+
+    t = threading.Thread(
+        target=lambda: guarded_collective(blocked_collective,
+                                          label="train/step_flags"))
+    with activate(tel):
+        t.start()
+        deadline = time.monotonic() + 5
+        while lv.current_guard().in_flight is None:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        time.sleep(0.25)  # cross the 0.2s deadline
+        assert check_deadline() == "escalated"
+    assert len(hits) == 1
+    assert "WorkerLostError" in hits[0]
+    assert "process 1" in hits[0]
+    assert str(lv.EXIT_WORKER_LOST) in hits[0]
+    # the collective had NOT returned when the guard fired
+    assert lv.current_guard().in_flight is not None
+    release.set()
+    t.join()
+    tel.close()
+    events = [e for e in _events(path)
+              if e.get("status") == "worker_lost"]
+    assert events and events[0]["label"] == "train/step_flags"
+    assert events[0]["timeout_seconds"] == 0.2
+
+
+def test_deadline_quiet_within_budget(tmp_path, guard_teardown):
+    lease = _lease(tmp_path, FakeClock(), index=0)
+    lease.renew()
+    install_guard(lease, 100.0, escalate=lambda m: (_ for _ in ()
+                                                    ).throw(
+        AssertionError("must not escalate")))
+    st = lv.current_guard()
+    st.in_flight = ("x", time.monotonic())
+    assert check_deadline() is None
+
+
+def test_deadline_slow_warning_when_everyone_alive(tmp_path,
+                                                   guard_teardown):
+    """Deadline exceeded but every peer still heartbeating: a one-shot
+    collective_slow warning, never an escalation — a slow save or
+    compile must not kill a healthy cluster."""
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0)
+    peer = _lease(tmp_path, clock, index=1)
+    me.renew()
+    peer.renew()
+    hits = []
+    install_guard(me, 0.1, escalate=hits.append)
+    st = lv.current_guard()
+    st.in_flight = ("checkpoint/final_save", time.monotonic() - 1.0)
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+    with activate(tel):
+        assert check_deadline() == "slow"
+        assert check_deadline() == "slow"  # warn-once, re-checks fine
+    tel.close()
+    assert hits == []
+    slow = [e for e in _events(path)
+            if e.get("status") == "collective_slow"]
+    assert len(slow) == 1  # one event despite two ticks
+    assert slow[0]["label"] == "checkpoint/final_save"
+
+
+def test_deadline_covers_unguarded_sync_points(tmp_path,
+                                               guard_teardown):
+    """No guarded call in flight, but none has COMPLETED within the
+    deadline either (async dispatch can park the thread in a
+    device_put or result unpack): the sentinel still escalates when a
+    peer is stale."""
+    clock = FakeClock()
+    lease = _lease(tmp_path, clock, index=0)
+    lease.renew()  # peer 1 stale
+    hits = []
+    install_guard(lease, 0.1, escalate=hits.append)
+    st = lv.current_guard()
+    st.last_progress = time.monotonic() - 1.0
+    assert check_deadline() == "escalated"
+    assert "no guarded collective completing" in hits[0]
+    # a completing guarded call resets the progress clock
+    hits.clear()
+    guarded_collective(lambda: None, label="x")
+    assert check_deadline() is None
+
+
+def test_guard_progress_beat_on_completion(tmp_path, guard_teardown):
+    lease = _lease(tmp_path, FakeClock(), index=0)
+    lease.renew()
+    install_guard(lease, 5.0)
+    st = lv.current_guard()
+    before = st.last_progress
+    time.sleep(0.01)
+    assert guarded_collective(lambda: 7, label="x") == 7
+    assert st.in_flight is None
+    assert st.last_progress > before
+
+
+# --- fmstat: DEGRADED verdict + worker table ------------------------------
+
+
+def _summary(health=(), crashes=(), starts=1, ends=1, gauges=None):
+    return {"health_events": list(health), "crash_events": list(crashes),
+            "run_starts": starts, "run_ends": ends,
+            "gauges_by_process": gauges or {}}
+
+
+def _lost_event(*pids):
+    return {"event": "health", "status": "worker_lost",
+            "label": "lockstep/window_fill",
+            "lost": [{"process_index": p, "host": f"h{p}",
+                      "age_seconds": 3.2} for p in pids]}
+
+
+def test_degraded_verdict_names_count():
+    hv = health_verdict(_summary(health=[
+        _lost_event(1),
+        {"event": "health", "status": "elastic_recovered",
+         "generation": 1, "members": [0], "lost": [1]}]))
+    assert hv["verdict"] == "DEGRADED (1 worker lost)"
+    assert "process 1" in hv["detail"]
+    assert "elastic shrink recovered" in hv["detail"]
+
+
+def test_degraded_verdict_plural_and_unrecovered():
+    hv = health_verdict(_summary(health=[_lost_event(1, 2)]))
+    assert hv["verdict"] == "DEGRADED (2 workers lost)"
+    assert "no elastic recovery recorded" in hv["detail"]
+
+
+def test_degraded_outranked_by_preempted_and_crash():
+    pre = {"event": "health", "status": "preempted", "step": 5,
+           "epoch": 0}
+    hv = health_verdict(_summary(health=[_lost_event(1), pre]))
+    assert hv["verdict"] == "PREEMPTED"
+    hv = health_verdict(_summary(
+        health=[_lost_event(1)],
+        crashes=[{"event": "crash", "error": "WorkerLostError: x"}]))
+    assert hv["verdict"] == "CRASHED"
+    assert "WorkerLostError" in hv["detail"]
+
+
+def test_degraded_beats_unclosed_stream_heuristic():
+    """The dead worker's shard has no run_end; that must read as part
+    of the DEGRADED story, not flip the verdict to CRASHED."""
+    hv = health_verdict(_summary(health=[_lost_event(1)], starts=2,
+                                 ends=1))
+    assert hv["verdict"].startswith("DEGRADED")
+    assert "no run_end" in hv["detail"]
+
+
+def test_degraded_ranked_below_stalled_is_above():
+    stall = {"event": "health", "status": "stalled",
+             "stalled_seconds": 9.0, "stacks_file": "s"}
+    hv = health_verdict(_summary(health=[_lost_event(1), stall]))
+    assert hv["verdict"].startswith("DEGRADED")
+
+
+def test_worker_table_rows_and_lost_flag():
+    rows = worker_table(_summary(
+        health=[_lost_event(1)],
+        gauges={0: {"worker/heartbeat_age_seconds": 0.4,
+                    "worker/windows": 12.0,
+                    "worker/examples": 3072.0},
+                1: {"worker/heartbeat_age_seconds": 0.5,
+                    "worker/windows": 5.0,
+                    "worker/examples": 1280.0},
+                2: {"train/examples_per_sec_window": 1.0}}))
+    assert len(rows) == 2  # proc 2 published no worker gauges
+    assert rows[0].startswith("p0:") and "LOST" not in rows[0]
+    assert rows[1].startswith("p1:") and rows[1].endswith("LOST")
+    assert "windows 5" in rows[1]
+
+
+def test_worker_gauges_ride_metrics_flush(tmp_path):
+    clock = FakeClock()
+    lease = _lease(tmp_path, clock, index=0)
+    lease.renew()
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+    tel.lease = lease
+    tel.count("lockstep/windows", 3)
+    tel.count("train/examples", 96)
+    tel.barrier_flush(7)
+    tel.close()
+    summary = summarize([path])
+    g = summary["gauges_by_process"][0]
+    assert g["worker/windows"] == 3
+    assert g["worker/examples"] == 96
+    assert g["worker/heartbeat_age_seconds"] >= 0
+    assert worker_table(summary)
+
+
+# --- config knobs ---------------------------------------------------------
+
+
+def test_config_rejects_bad_elastic_values():
+    from fast_tffm_tpu.config import FmConfig
+    with pytest.raises(ValueError, match="elastic"):
+        FmConfig(elastic="grow")
+    with pytest.raises(ValueError, match="heartbeat_seconds"):
+        FmConfig(elastic="shrink", heartbeat_seconds=0.0)
+    with pytest.raises(ValueError, match="collective_timeout_seconds"):
+        FmConfig(collective_timeout_seconds=-1.0)
+    with pytest.raises(ValueError, match="heartbeat_seconds"):
+        FmConfig(heartbeat_seconds=-0.5)
+    cfg = FmConfig(elastic="shrink", heartbeat_seconds=2.0,
+                   collective_timeout_seconds=0.0)
+    assert cfg.elastic == "shrink"
+
+
+def test_cluster_cfg_keys_parse(tmp_path):
+    from fast_tffm_tpu.config import load_config
+    p = tmp_path / "c.cfg"
+    p.write_text("""
+[Cluster]
+worker_hosts = a:1,b:2
+collective_timeout_seconds = 45
+heartbeat_seconds = 2.5
+elastic = shrink
+""")
+    cfg = load_config(str(p))
+    assert cfg.collective_timeout_seconds == 45.0
+    assert cfg.heartbeat_seconds == 2.5
+    assert cfg.elastic == "shrink"
+
+
+def test_generation_bumps_coordinator_port():
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.parallel.distributed import coordinator_address
+    cfg = FmConfig(worker_hosts=("h0:7000", "h1:7001", "h2:7002"))
+    assert coordinator_address(cfg) == "h0:8000"
+    assert coordinator_address(cfg, generation=2) == "h0:8002"
+    # reform passes the SURVIVORS: the new chief is the first of them
+    assert coordinator_address(cfg, generation=1,
+                               hosts=["h1:7001", "h2:7002"]) == "h1:8002"

@@ -99,3 +99,48 @@ def test_empty_iterator_yields_nothing(rig):
 
 def test_window_constant_is_sane():
     assert sharded.LOCKSTEP_WINDOW >= 2
+
+
+def test_preempt_flag_stops_at_window_boundary(rig):
+    """ISSUE 6 satellite: the preemption flag rides the fill allgather
+    — a raised flag ends the sweep BEFORE any of that window's
+    collective programs dispatch, so every process (all of them see
+    the same gathered flags) stops at the same boundary."""
+    cfg, mesh, table, score_fn, data, ub = rig
+    windows_seen = []
+
+    def preempt():
+        # flips true while the SECOND window is being agreed on
+        return len(windows_seen) >= 1
+
+    it = batch_iterator(cfg, [data], training=False, epochs=1,
+                        fixed_shape=True, uniq_bucket=ub)
+    out = []
+    for batch, local in lockstep_score_batches(cfg, it, mesh, score_fn,
+                                               table, ub,
+                                               preempt=preempt):
+        out.append(batch)
+        if len(out) % sharded.LOCKSTEP_WINDOW == 0:
+            windows_seen.append(len(out))
+    # exactly the first window was scored; the second was cut at the
+    # boundary, before dispatch
+    assert len(out) == sharded.LOCKSTEP_WINDOW
+
+
+def test_preempt_flag_before_first_window_yields_nothing(rig):
+    cfg, mesh, table, score_fn, data, ub = rig
+    it = batch_iterator(cfg, [data], training=False, epochs=1,
+                        fixed_shape=True, uniq_bucket=ub)
+    out = list(lockstep_score_batches(cfg, it, mesh, score_fn, table,
+                                      ub, preempt=lambda: True))
+    assert out == []
+
+
+def test_no_preempt_scores_everything(rig):
+    """preempt=None and a never-true preempt are both full sweeps."""
+    cfg, mesh, table, score_fn, data, ub = rig
+    it = batch_iterator(cfg, [data], training=False, epochs=1,
+                        fixed_shape=True, uniq_bucket=ub)
+    out = list(lockstep_score_batches(cfg, it, mesh, score_fn, table,
+                                      ub, preempt=lambda: False))
+    assert len(out) == 23

@@ -40,6 +40,23 @@ asserts the documented recovery behavior:
                       cleanly (verified restore; orbax's atomic commit
                       plus the manifest check hide/catch any torn
                       state) and completes OK.
+- ``kill-worker-midwindow`` SIGKILL one of 2 lockstep workers mid-run.
+                      With ``elastic = shrink`` the survivor raises
+                      the worker_lost diagnosis naming the dead
+                      process within the collective deadline, reforms
+                      a 1-worker cluster, restores the last verified
+                      checkpoint, re-shards the input so every shard
+                      of the recovered pass is consumed exactly once
+                      (pinned by final step arithmetic), finishes the
+                      schedule, and ``fmstat`` reports
+                      ``DEGRADED (1 worker lost)``. With
+                      ``elastic = off`` the survivor fails FAST with
+                      the same named diagnosis instead of hanging.
+- ``hang-worker``     SIGSTOP one of 2 lockstep workers: the deadline
+                      guard expires, the diagnosis names the stopped
+                      process (it stopped heartbeating without dying),
+                      and the survivor exits with WorkerLostError —
+                      never an indefinite hang.
 
 The scenario functions are plain callables (workdir in, asserts
 inside) so tests/test_chaos.py runs the same soaks under tier-1; the
@@ -393,6 +410,280 @@ log_steps = 0
             f"(verdict {v!r})")
 
 
+# --- multi-worker compute-plane scenarios --------------------------------
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _write_cluster_cfg(workdir: str, data: str, model: str,
+                       metrics: str, epoch_num: int, elastic: str,
+                       collective_timeout: float = 30.0,
+                       save_steps: int = 0) -> str:
+    """A 2-worker localhost cluster config with the compute-plane
+    knobs the scenarios exercise: sub-second heartbeats so a dead
+    worker goes visibly stale fast, and a small collective deadline so
+    a hang is diagnosed in test time, not operator time."""
+    coord = _free_port()
+    cfg_path = os.path.join(workdir, f"cluster_{elastic}.cfg")
+    with open(cfg_path, "w") as fh:
+        fh.write(f"""
+[General]
+vocabulary_size = 200
+factor_num = 4
+model_file = {model}
+
+[Train]
+train_files = {data}
+epoch_num = {epoch_num}
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+log_steps = 0
+save_steps = {save_steps}
+metrics_file = {metrics}
+metrics_flush_steps = 2
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+cluster_connect_timeout_seconds = 120
+collective_timeout_seconds = {collective_timeout}
+heartbeat_seconds = 0.4
+elastic = {elastic}
+""")
+    return cfg_path
+
+
+def _spawn_workers(workdir: str, cfg_path: str, n: int = 2):
+    """Launch n real worker processes (run_tffm.py train ... dist_train
+    worker i), stdout+stderr into worker<i>.out files."""
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    for i in range(n):
+        out = open(os.path.join(workdir, f"worker{i}.out"), "w")
+        procs.append((subprocess.Popen(
+            [sys.executable, "run_tffm.py", "train", cfg_path,
+             "dist_train", "worker", str(i)],
+            cwd=repo, env=env, stdout=out, stderr=subprocess.STDOUT),
+            out))
+    return procs
+
+
+def _worker_out(workdir: str, i: int) -> str:
+    with open(os.path.join(workdir, f"worker{i}.out")) as fh:
+        return fh.read()
+
+
+def _metrics_step(metrics_path: str) -> int:
+    """Latest flushed train/steps counter in a (possibly mid-write)
+    metrics stream — the milestone the scenarios key fault delivery
+    on: steps flushing means every worker is past bring-up and
+    stepping in lockstep."""
+    best = 0
+    try:
+        with open(metrics_path, encoding="utf-8") as fh:
+            for line in fh:
+                if '"metrics"' not in line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn tail mid-write
+                best = max(best, int((rec.get("counters") or {})
+                                     .get("train/steps", 0)))
+    except OSError:
+        pass
+    return best
+
+
+def _reap(procs, sig=None) -> None:
+    """Never leak a worker, assertions included. ``sig`` is delivered
+    first to still-running workers (the hang scenario SIGCONTs its
+    frozen worker so the SIGKILL can land)."""
+    for p, out in procs:
+        if p.poll() is None:
+            if sig is not None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+            try:
+                p.kill()
+            except OSError:
+                pass
+        try:
+            p.wait(timeout=30)
+        finally:
+            out.close()
+
+
+def scenario_kill_worker_midwindow(workdir: str, seed: int = 0) -> str:
+    """SIGKILL one of 2 lockstep workers mid-run: with elastic=shrink
+    the survivor diagnoses, reforms, restores the last verified
+    checkpoint, and finishes the WHOLE schedule with every input shard
+    of the recovered pass consumed exactly once; with elastic=off the
+    survivor fails fast with the same named diagnosis."""
+    import re
+    import signal
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.checkpoint import CheckpointState
+    from fast_tffm_tpu.testing.faults import committed_steps, wait_until
+    from fast_tffm_tpu.train import checkpoint_template
+    workdir = os.path.abspath(workdir)
+    data = os.path.join(workdir, "train_elastic.txt")
+    n_lines, batch = 4864, 32         # 152 exact steps per single pass
+    steps_per_pass = n_lines // batch
+    _write_corpus(data, n_lines, seed)
+
+    # Phase A (elastic=shrink): a fresh 2-worker job with periodic
+    # saves; SIGKILL worker 1 in the window between two saves — after
+    # a committed step exists (the recovery's restore point) and well
+    # clear of the next save's orbax commit barrier.
+    model = os.path.join(workdir, "model", "fm")
+    metrics = os.path.join(workdir, "metrics.jsonl")
+    epochs, save_steps = 4, 60
+    cfg_path = _write_cluster_cfg(workdir, data, model, metrics,
+                                  epoch_num=epochs, elastic="shrink",
+                                  save_steps=save_steps)
+    procs = _spawn_workers(workdir, cfg_path)
+    try:
+        def mid_save_window() -> bool:
+            committed = committed_steps(model)
+            if not committed:
+                return False
+            s = _metrics_step(metrics)
+            return (s >= committed[-1] + 3
+                    and s % save_steps < save_steps - 15)
+
+        wait_until(mid_save_window, timeout=240, interval=0.02,
+                   message="2-worker job stepping past a committed "
+                           "save, clear of the next")
+        procs[1][0].send_signal(signal.SIGKILL)
+        wait_until(lambda: procs[0][0].poll() is not None, timeout=300,
+                   message="survivor finishing after the kill")
+    finally:
+        _reap(procs)
+    out0 = _worker_out(workdir, 0)
+    assert procs[0][0].returncode == 0, (
+        f"survivor failed:\n{out0[-3000:]}")
+    assert "worker lost" in out0 and "process 1" in out0, out0[-3000:]
+    assert "elastic reform generation 1" in out0, out0[-3000:]
+    assert "elastic recovery complete" in out0, out0[-3000:]
+    assert "training done" in out0, out0[-3000:]
+    # Exactly-once recovered pass: the survivor restored the last
+    # verified checkpoint (step s0, epoch e0) and re-ran epochs
+    # e0..epochs-1 ALONE, so each recovered epoch is one full
+    # 152-step pass over every byte of the corpus — the dead worker's
+    # shards redistributed by construction. Any dropped or
+    # double-consumed shard changes the final step count.
+    restores = re.findall(r"restored checkpoint at step (\d+)", out0)
+    assert restores, "recovered session never restored a checkpoint"
+    s0 = int(restores[-1])
+    resumes = re.findall(
+        r"resuming interrupted epoch schedule at epoch (\d+)/", out0)
+    e0 = int(resumes[-1]) if resumes else 0
+    cfg = FmConfig(vocabulary_size=200, factor_num=4, batch_size=batch,
+                   epoch_num=epochs, train_files=(data,),
+                   model_file=model)
+    ckpt = CheckpointState(model)
+    final = ckpt.restore(template=checkpoint_template(cfg))
+    ckpt.close()
+    want_step = s0 + (epochs - e0) * steps_per_pass
+    assert int(final["step"]) == want_step, (int(final["step"]),
+                                             want_step, s0, e0)
+    assert int(final["epoch"]) == epochs, int(final["epoch"])
+    # fmstat over the chief stream + the dead worker's shard: the
+    # worker_lost diagnosis and the elastic recovery land in ONE run
+    # segment, and the verdict is DEGRADED (ranked below PREEMPTED).
+    from fast_tffm_tpu.obs.attribution import health_verdict, summarize
+    shards = [metrics] + ([metrics + ".p1"]
+                          if os.path.exists(metrics + ".p1") else [])
+    summary = summarize(shards)
+    statuses = [h.get("status") for h in summary["health_events"]]
+    assert "worker_lost" in statuses, statuses
+    assert "elastic_recovered" in statuses, statuses
+    v = health_verdict(summary)["verdict"]
+    assert v == "DEGRADED (1 worker lost)", v
+
+    # Phase B: same kill, elastic=off — fail FAST with the named
+    # diagnosis (bounded by the collective deadline), never a hang.
+    offdir = os.path.join(workdir, "off")
+    os.makedirs(offdir, exist_ok=True)
+    off_metrics = os.path.join(offdir, "metrics.jsonl")
+    off_cfg = _write_cluster_cfg(
+        offdir, data, os.path.join(offdir, "model", "fm"), off_metrics,
+        epoch_num=20, elastic="off", collective_timeout=20.0)
+    procs = _spawn_workers(offdir, off_cfg)
+    try:
+        wait_until(lambda: _metrics_step(off_metrics) >= 4, timeout=240,
+                   message="elastic=off job stepping")
+        procs[1][0].send_signal(signal.SIGKILL)
+        # Fail-fast bound: deadline + staleness grace + teardown slack.
+        wait_until(lambda: procs[0][0].poll() is not None, timeout=120,
+                   message="elastic=off survivor failing fast")
+    finally:
+        _reap(procs)
+    out0 = _worker_out(offdir, 0)
+    assert procs[0][0].returncode != 0, "elastic=off must fail fast"
+    assert "WorkerLostError" in out0 and "process 1" in out0, (
+        out0[-3000:])
+    return (f"shrink: survivor recovered to step {want_step}/"
+            f"epoch {epochs} with verdict {v!r}; off: survivor failed "
+            "fast naming process 1")
+
+
+def scenario_hang_worker(workdir: str, seed: int = 0) -> str:
+    """SIGSTOP one of 2 lockstep workers: the deadline guard expires
+    and the survivor exits with a WorkerLostError naming the stopped
+    process (its heartbeats went quiet without the process dying) —
+    never an indefinite hang."""
+    import signal
+    from fast_tffm_tpu.testing.faults import wait_until
+    workdir = os.path.abspath(workdir)
+    data = os.path.join(workdir, "train_hang.txt")
+    _write_corpus(data, 1216, seed)
+    metrics = os.path.join(workdir, "metrics.jsonl")
+    cfg_path = _write_cluster_cfg(
+        workdir, data, os.path.join(workdir, "model", "fm"), metrics,
+        epoch_num=20, elastic="off", collective_timeout=8.0)
+    procs = _spawn_workers(workdir, cfg_path)
+    try:
+        wait_until(lambda: _metrics_step(metrics) >= 4, timeout=240,
+                   message="2-worker job stepping")
+        procs[1][0].send_signal(signal.SIGSTOP)
+        # Never an indefinite hang: the guard's 8s deadline + the
+        # staleness grace bound the diagnosis; 120s covers teardown.
+        wait_until(lambda: procs[0][0].poll() is not None, timeout=120,
+                   message="survivor diagnosing the stopped worker")
+    finally:
+        _reap(procs, sig=signal.SIGCONT)
+    out0 = _worker_out(workdir, 0)
+    assert procs[0][0].returncode != 0, (
+        "survivor must fail fast, not complete, when a peer is "
+        "stopped mid-schedule")
+    assert "WorkerLostError" in out0, out0[-3000:]
+    assert "process 1" in out0, out0[-3000:]
+    from fast_tffm_tpu.obs.attribution import summarize
+    summary = summarize([metrics])
+    lost = [h for h in summary["health_events"]
+            if h.get("status") == "worker_lost"]
+    assert lost, summary["health_events"]
+    named = {p.get("process_index")
+             for h in lost for p in h.get("lost", [])}
+    assert 1 in named, named
+    return ("survivor diagnosed the SIGSTOPped worker 1 within the "
+            "collective deadline and exited with WorkerLostError")
+
+
 SCENARIOS: Dict[str, Callable[..., str]] = {
     "skip": scenario_skip,
     "quarantine": scenario_quarantine,
@@ -401,6 +692,8 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "preempt-resume": scenario_preempt_resume,
     "truncate-latest": scenario_truncate_latest,
     "kill-async-save": scenario_kill_async_save,
+    "kill-worker-midwindow": scenario_kill_worker_midwindow,
+    "hang-worker": scenario_hang_worker,
 }
 
 

@@ -203,5 +203,56 @@ def r005_ckpt_delete(path: str, tree: ast.AST) -> List[Finding]:
     return found
 
 
+# R006 scope: the modules whose blocking host collectives can park a
+# whole cluster — the drivers, the lockstep protocol, and the restore
+# broadcasts. parallel/liveness.py is the guard's own implementation
+# (it receives collectives as arguments, never names them bare).
+R006_MODULE_SUFFIXES = (
+    "fast_tffm_tpu/train.py",
+    "fast_tffm_tpu/predict.py",
+    "fast_tffm_tpu/checkpoint.py",
+)
+R006_PACKAGE_FRAGMENTS = ("fast_tffm_tpu/parallel/",)
+R006_COLLECTIVES = ("process_allgather", "broadcast_one_to_all",
+                    "sync_global_devices")
+
+
+def r006_unguarded_collective(path: str, tree: ast.AST) -> List[Finding]:
+    """A bare blocking host collective (``process_allgather``,
+    ``broadcast_one_to_all``, ``sync_global_devices``) CALLED outside
+    ``guarded_collective()`` in the cluster-critical modules: one dead
+    or wedged peer parks every caller of such a collective forever —
+    the hang-forever failure mode the deadline guards exist to remove
+    (parallel/liveness.py). Pass the collective INTO
+    ``guarded_collective(multihost_utils.process_allgather, ...)`` —
+    referencing the function is fine, calling it bare is the finding.
+    Deliberate unguarded calls carry a justified pragma."""
+    p = path.replace("\\", "/")
+    in_scope = (p.endswith(R006_MODULE_SUFFIXES)
+                or any(frag in p for frag in R006_PACKAGE_FRAGMENTS))
+    if not in_scope or p.endswith("parallel/liveness.py"):
+        return []
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute) and f.attr in R006_COLLECTIVES:
+            name = f.attr
+        elif isinstance(f, ast.Name) and f.id in R006_COLLECTIVES:
+            name = f.id
+        if name is None:
+            continue
+        found.append(Finding(
+            "R006", path, node.lineno,
+            f"bare {name}() blocks forever on a dead peer; run it "
+            "under parallel.liveness.guarded_collective(fn, ...) so a "
+            "lost worker raises a named WorkerLostError, or justify "
+            "with a pragma"))
+    return found
+
+
 RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter,
-         r004_swallowed_exception, r005_ckpt_delete)
+         r004_swallowed_exception, r005_ckpt_delete,
+         r006_unguarded_collective)

@@ -12,9 +12,14 @@ merge rules (counters add, histograms bucket-merge, gauges per
 process) and renders the same attribution table bench.py's breakdown
 teaches: examples/sec, step-time quantiles, input-wait / pause /
 transfer split, dedup hit rate, padding waste, and a host-bound vs
-device/transfer-bound vs pause-bound verdict. ``--json`` emits the
-merged summary + attribution as one JSON object for scripting.
-``--tail`` follows a live file and pretty-prints events as they land.
+device/transfer-bound vs pause-bound verdict. Multi-worker runs with
+the heartbeat lease on additionally get a per-worker liveness table
+(last heartbeat age, lockstep windows, examples; LOST flag on workers
+named by a ``worker_lost`` diagnosis) and the
+``DEGRADED (N workers lost)`` health verdict (README "Elastic
+multi-host"). ``--json`` emits the merged summary + attribution as one
+JSON object for scripting. ``--tail`` follows a live file and
+pretty-prints events as they land.
 """
 
 from __future__ import annotations
