@@ -6,7 +6,8 @@
 #   make            build the parser extension
 #   make test       run the test suite
 #   make bench      run the benchmark (one JSON line)
-#   make lint       fmlint over the hot-loop modules
+#   make lint       fmlint whole-program pass (R000-R010) over
+#                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
 #   make clean
 

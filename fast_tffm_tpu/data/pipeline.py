@@ -365,10 +365,8 @@ def _iter_owned_chunks(path: str, start: int, end: int,
     (truncated/merged lines — wrong training data, the worst failure
     mode this module exists to prevent).
     """
-    if retry is None:
-        fh = open(path, "rb")
-    else:
-        fh = open_with_retry(path, "rb", policy=retry, op="data_open")
+    fh = (open(path, "rb") if retry is None else
+          open_with_retry(path, "rb", policy=retry, op="data_open"))
 
     def read(n: int) -> bytes:
         if retry is None:
@@ -431,7 +429,8 @@ def _iter_range_lines(path: str, start: int, end: int,
         yield tail.decode("utf-8")
 
 
-def _owned_start_line_index(path: str, start: int) -> int:
+def _owned_start_line_index(path: str, start: int,
+                            retry: Optional[RetryPolicy] = None) -> int:
     """Global line index of the first line OWNED by a byte range
     beginning at ``start`` (ownership rules of _iter_owned_chunks) == the
     newline count in [0, s) where s is that line's byte offset. A pure
@@ -449,16 +448,23 @@ def _owned_start_line_index(path: str, start: int) -> int:
     inside one mtime clock tick, which no stat-based key can see."""
     st = os.stat(path)
     return _owned_start_line_index_for(path, start, st.st_size,
-                                       st.st_mtime_ns, st.st_ino)
+                                       st.st_mtime_ns, st.st_ino,
+                                       retry)
 
 
 @functools.lru_cache(maxsize=512)
 def _owned_start_line_index_for(path: str, start: int, _size: int,
-                                _mtime_ns: int, _ino: int) -> int:
+                                _mtime_ns: int, _ino: int,
+                                retry: Optional[RetryPolicy] = None
+                                ) -> int:
     if start <= 0:
         return 0
     n = 0
-    with open(path, "rb") as fh:
+    # RetryPolicy is a frozen (hashable) dataclass, so it rides the
+    # memo key; the scan is a pure prefix read, safe to re-drive whole.
+    with (open(path, "rb") if retry is None else
+          open_with_retry(path, "rb", policy=retry,
+                          op="sidecar_align")) as fh:
         # Newlines strictly before `start - 1`, then resolve the
         # boundary: the newline at/after start-1 terminates the previous
         # owner's line, so the first owned line is one past it.
@@ -509,7 +515,7 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                 f"{len(files)} files)")
         for path, wpath in zip(files, weight_files):
             start, end = shard_byte_range(path, shard_index, num_shards)
-            n_skip = _owned_start_line_index(path, start)
+            n_skip = _owned_start_line_index(path, start, retry)
             wfh = (open(wpath) if retry is None else
                    open_with_retry(wpath, policy=retry,
                                    op="sidecar_open"))

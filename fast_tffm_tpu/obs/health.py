@@ -88,8 +88,15 @@ class Watchdog:
         if self._stalled_at is None:
             if now - beat_t <= self.stall_seconds:
                 return None
+            # fmlint: disable=R008 -- single-writer by design: episode
+            # state (_stalled_at, stall_events) is touched ONLY by
+            # check(), which runs on the one watchdog thread (tests
+            # call it directly with the thread stopped); the hot-path
+            # beat() stays a GIL-atomic tuple assignment precisely so
+            # the train loop never takes a lock for the watchdog
             self._stalled_at = beat_t
-            self.stall_events += 1
+            self.stall_events += 1  # fmlint: disable=R008 -- same
+            # single-writer episode state as _stalled_at above
             self.sink.emit("health", {
                 "status": "stalled",
                 "stalled_seconds": now - beat_t,
@@ -102,7 +109,8 @@ class Watchdog:
             return "stalled"
         if beat_t > self._stalled_at:  # progress resumed
             outage = beat_t - self._stalled_at
-            self._stalled_at = None
+            self._stalled_at = None  # fmlint: disable=R008 -- same
+            # single-writer episode state: only check() clears it
             self.sink.emit("health", {
                 "status": "recovered",
                 "outage_seconds": outage,

@@ -233,8 +233,13 @@ class HeartbeatLease:
         stale_ids = {i.process_index for i in stale}
         fresh = [i for i in stale
                  if i.process_index not in self._reported_lost]
+        # fmlint: disable=R008 -- single-writer by design: episode
+        # dedup state is touched only by check_peers(), which runs on
+        # the one heartbeat-lease monitor thread (tests call it
+        # directly with the thread stopped); no other thread reads it
         self._reported_lost &= stale_ids  # recovered peers re-arm
         for info in fresh:
+            # fmlint: disable=R008 -- same monitor-thread-only state
             self._reported_lost.add(info.process_index)
             _emit_worker_lost([info], label="heartbeat_monitor")
         return fresh

@@ -20,12 +20,43 @@ def _hot_file(tmp_path, body):
     return str(p)
 
 
-def test_repo_hot_modules_are_clean():
-    """The lint gate: the shipped hot-loop modules must have zero
-    findings (deliberate exceptions carry justified pragmas)."""
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    findings = run_paths([os.path.join(root, "fast_tffm_tpu")])
+def test_repo_surface_is_clean():
+    """THE lint gate: the full default surface — fast_tffm_tpu/,
+    tools/ (fmlint lints itself), run_tffm.py, bench.py — must have
+    zero findings under every rule, per-file AND whole-program
+    (deliberate exceptions carry justified pragmas; the committed
+    baseline is empty). R999 parse failures anywhere on this surface
+    fail here too."""
+    from tools.fmlint.core import default_baseline_path, default_paths
+    findings = run_paths(default_paths(),
+                         baseline=default_baseline_path())
     assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_default_surface_includes_tools_and_cli():
+    """ISSUE 7 satellite: the no-argument lint surface reaches beyond
+    the package to the tools and CLI entry points."""
+    from tools.fmlint.core import default_paths
+    names = [os.path.basename(p) for p in default_paths()]
+    assert names == ["fast_tffm_tpu", "tools", "run_tffm.py",
+                     "bench.py"]
+
+
+def test_collect_files_is_deterministic_and_sorted(tmp_path):
+    """ISSUE 7 satellite: finding order (and therefore baseline
+    diffs) must be stable across filesystems — both the directory
+    descent and per-directory file order are sorted."""
+    from tools.fmlint.core import collect_files
+    for rel in ("b/zz.py", "b/aa.py", "a/x.py", "c/__pycache__/j.py",
+                "top.py"):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("x = 1\n")
+    got = [os.path.relpath(f, tmp_path)
+           for f in collect_files([str(tmp_path)])]
+    assert got == ["top.py", "a/x.py", "b/aa.py", "b/zz.py"]
+    assert got == [os.path.relpath(f, tmp_path)
+                   for f in collect_files([str(tmp_path)])]
 
 
 def test_is_hot_module_scope():
@@ -398,3 +429,62 @@ def test_r006_respects_pragma(tmp_path):
             return multihost_utils.process_allgather(x)
     """)
     assert run_file(path) == []
+
+
+# --- pragma edge cases (ISSUE 7 satellite) ---------------------------------
+
+def test_wholeline_pragma_above_decorated_function(tmp_path):
+    """A whole-line pragma above a DECORATED function suppresses the
+    whole function statement: the decorator is an expression, not a
+    statement, so the next statement span is the full def (decorators
+    included in neither — the span runs def..end of body)."""
+    path = _hot_file(tmp_path, """\
+        import functools
+        # fmlint: disable=R001 -- whole helper reads host values
+        @functools.lru_cache(maxsize=8)
+        def read(loss, it):
+            for x in it:
+                v = float(x)
+            return v + loss.item()
+    """)
+    assert run_file(path) == []
+
+
+def test_wholeline_pragma_covers_finding_on_last_span_line(tmp_path):
+    """Multi-line call spans: the pragma covers findings anchored on
+    ANY line of the next statement, including the last."""
+    path = _hot_file(tmp_path, """\
+        def run(it, f):
+            for x in it:
+                # fmlint: disable=R001 -- host tuple unpack
+                v = f(x[0],
+                      x[1],
+                      int(x[2]))
+            return v
+    """)
+    assert run_file(path) == []
+
+
+def test_disable_file_without_justification_is_r000(tmp_path):
+    """``disable-file=`` without a ``--`` rationale is itself reported
+    AND does not suppress anything."""
+    path = _hot_file(tmp_path, """\
+        # fmlint: disable-file=R002
+        def log(x):
+            print(x)
+    """)
+    rules = sorted(f.rule for f in run_file(path))
+    assert rules == ["R000", "R002"]
+
+
+def test_r999_fails_gate_for_expanded_surface(tmp_path):
+    """A syntax error anywhere on a linted surface (e.g. a tools/
+    module) surfaces as R999 through the whole-program runner and
+    fails the gate."""
+    d = tmp_path / "tools" / "fmthing"
+    d.mkdir(parents=True)
+    (d / "__init__.py").write_text("def broken(:\n")
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    findings = run_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["R999"]
+    assert findings[0].path.endswith("__init__.py")

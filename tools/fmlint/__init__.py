@@ -1,21 +1,38 @@
-"""fmlint — AST-based static checks for this repo's performance
-invariants.
+"""fmlint — static checks for this repo's performance and
+cluster-correctness invariants.
 
 The invariants live in prose (README "Device-link sync pathology",
-BASELINE.md's measured one-fetch-collapses-dispatch pathology); this
-package makes the hot-loop subset machine-checked and wires it into
-the tier-1 test run (tests/test_fmlint.py):
+the PR 3-5 robustness postmortems); this package makes them
+machine-checked and wires them into the tier-1 test run
+(tests/test_fmlint.py). Two layers:
 
-R001  per-scalar device fetch in a hot-loop module: ``float(x)`` /
-      ``int(x)`` inside a loop body, or any ``.item()`` call — one
-      synchronous scalar materialization in the hot stream costs
-      seconds over a tunnelled device link (measured 528k -> 50k
-      examples/sec).
-R002  bare ``print(`` in a hot-loop module: stdout writes block the
-      dispatch loop and bypass the logging/telemetry sinks.
+Per-file rules (stdlib-``ast``, tools/fmlint/rules.py):
 
-Hot-loop modules: train.py, predict.py, data/pipeline.py, and all of
-obs/ (the telemetry layer must never cause the stalls it measures).
+R001  per-scalar device fetch in a hot-loop module (``float``/``int``
+      in a loop body, any ``.item()``) — one synchronous scalar
+      materialization in the hot stream costs seconds over a
+      tunnelled device link (measured 528k -> 50k examples/sec).
+R002  bare ``print(`` in a hot-loop module.
+R003  raw ``perf_counter()`` pairs in hot loops (use obs.trace.span).
+R004  broad swallow-and-continue handlers in hot modules.
+R005  checkpoint deletion outside checkpoint.py (quarantine, never
+      delete).
+R006  bare blocking collective outside ``guarded_collective()``.
+R999  file fails to parse (fails the gate for the whole surface).
+
+Whole-program rules (tools/fmlint/project.py builds one parsed,
+import-resolved, call-graph-summarized model of the full lint
+surface; tools/fmlint/xrules.py consumes it):
+
+R007  a collective reachable (transitively) on only one arm of a
+      rank-conditioned branch — the multi-host deadlock.
+R008  shared state written from a provably thread-reachable function
+      without holding a lock.
+R009  config/knob drift: knobs missing from sample.cfg/README,
+      unknown sample.cfg keys, inconsistent ``FM_*`` env fallbacks,
+      stale ``cfg.<attr>`` reads.
+R010  raw ``open()`` on pipeline/checkpoint hot paths with no
+      utils/retry wrapper and no explicit OSError contract.
 
 Deliberate exceptions carry a justified pragma:
 
@@ -23,10 +40,14 @@ Deliberate exceptions carry a justified pragma:
 
 A whole-line pragma comment suppresses the entire next statement; a
 pragma without a ``--`` justification is itself reported (R000).
+``tools/fmlint/baseline.txt`` holds the committed baseline for
+gradual adoption (``--update-baseline`` / ``--baseline``); ``--json``
+emits machine-readable findings.
 
-Run: ``python -m tools.fmlint`` (repo default paths) or pass files.
+Run: ``python -m tools.fmlint`` (whole repo surface: fast_tffm_tpu/,
+tools/, run_tffm.py, bench.py) or pass files/dirs.
 """
 
-from tools.fmlint.core import Finding, main, run_paths
+from tools.fmlint.core import Finding, main, run_file, run_paths
 
-__all__ = ["Finding", "main", "run_paths"]
+__all__ = ["Finding", "main", "run_file", "run_paths"]

@@ -102,16 +102,28 @@ def parse_suppressions(path: str, source: str,
                        bad_pragmas=bad)
 
 
-def run_file(path: str) -> List[Finding]:
-    from tools.fmlint.rules import RULES
-    with open(path, "r", encoding="utf-8") as fh:
-        source = fh.read()
+def _parse_one(path: str, source: Optional[str] = None):
+    """(source, tree, suppressions) for one file, or a one-element
+    R999 finding list when it doesn't parse."""
+    if source is None:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
-        return [Finding("R999", path, e.lineno or 0,
-                        f"syntax error: {e.msg}")]
-    supp = parse_suppressions(path, source, tree)
+        return source, None, [Finding("R999", path, e.lineno or 0,
+                                      f"syntax error: {e.msg}")]
+    return source, tree, parse_suppressions(path, source, tree)
+
+
+def run_file(path: str) -> List[Finding]:
+    """Per-file rules only (R000-R006 + R999). The whole-program pass
+    (R007-R010; tools/fmlint/xrules.py) needs the full surface — use
+    ``run_paths``."""
+    from tools.fmlint.rules import RULES
+    source, tree, supp = _parse_one(path)
+    if tree is None:
+        return supp  # the R999 finding list
     found: List[Finding] = list(supp.bad_pragmas)
     for rule_fn in RULES:
         found.extend(f for f in rule_fn(path, tree)
@@ -122,13 +134,17 @@ def run_file(path: str) -> List[Finding]:
 def collect_files(paths: Sequence[str]) -> List[str]:
     """Expand dirs to their .py files. A path that doesn't exist or
     isn't lintable raises — a typo'd lint target must fail the gate,
-    not exit 0 having linted zero files."""
+    not exit 0 having linted zero files. Fully deterministic: both the
+    directory descent order and the per-directory file order are
+    sorted, so finding order — and therefore baseline diffs — is
+    stable across filesystems."""
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
             for root, _dirs, names in os.walk(p):
-                if "__pycache__" in root:
-                    continue
+                # In-place: os.walk descends in THIS order.
+                _dirs[:] = sorted(d for d in _dirs
+                                  if d != "__pycache__")
                 out.extend(os.path.join(root, n) for n in sorted(names)
                            if n.endswith(".py"))
         elif os.path.isfile(p) and p.endswith(".py"):
@@ -139,30 +155,177 @@ def collect_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
-def run_paths(paths: Sequence[str]) -> List[Finding]:
+def run_paths(paths: Sequence[str],
+              overlay: Optional[Dict[str, str]] = None,
+              baseline: Optional[str] = None) -> List[Finding]:
+    """The whole-program pass: every file parsed ONCE, per-file rules
+    (R000-R006) plus the cross-file rules (R007-R010) over one shared
+    project model (tools/fmlint/project.py). ``overlay`` maps absolute
+    paths to replacement source (the mutant-testing seam);
+    ``baseline`` filters findings recorded in a committed baseline
+    file (gradual adoption — see load_baseline)."""
+    from tools.fmlint.rules import RULES
+    from tools.fmlint.project import load_project
+    from tools.fmlint.xrules import PROGRAM_RULES
+    overlay = {os.path.abspath(k): v for k, v in (overlay or {}).items()}
     found: List[Finding] = []
+    entries = []                      # (abspath, source, tree)
+    supp_by_path: Dict[str, Suppressions] = {}
     for f in collect_files(paths):
-        found.extend(run_file(f))
-    return found
+        ap = os.path.abspath(f)
+        source, tree, supp = _parse_one(ap, overlay.get(ap))
+        if tree is None:
+            found.extend(supp)        # R999: excluded from the project
+            continue
+        entries.append((ap, source, tree))
+        supp_by_path[ap] = supp
+        found.extend(supp.bad_pragmas)
+        for rule_fn in RULES:
+            found.extend(x for x in rule_fn(ap, tree)
+                         if not supp.allows(x))
+    proj = load_project(entries)
+    for rule_fn in PROGRAM_RULES:
+        for x in rule_fn(proj):
+            supp = supp_by_path.get(os.path.abspath(x.path))
+            # Non-python findings (sample.cfg drift) carry no pragma
+            # surface; the baseline below is their suppression path.
+            if supp is None or not supp.allows(x):
+                found.append(x)
+    if baseline:
+        found = apply_baseline(found, baseline, proj.root)
+    return sorted(found, key=lambda f: (f.path, f.line, f.rule))
+
+
+# --- committed baseline ----------------------------------------------------
+#
+# Gradual adoption: a repo turning a new rule on records its existing
+# findings once (``--update-baseline``) and commits the file; the gate
+# then fails only on NEW findings. Entries are line-number-free
+# (``relpath|rule|message``) so unrelated edits shifting a file don't
+# churn the baseline; each entry absorbs at most as many findings as
+# its multiplicity.
+
+def load_baseline(path: str) -> List[str]:
+    keys: List[str] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                keys.append(line)
+    return keys
+
+
+def baseline_key(f: Finding, root: str) -> str:
+    rel = os.path.relpath(os.path.abspath(f.path), root)
+    return f"{rel.replace(os.sep, '/')}|{f.rule}|{f.message}"
+
+
+def apply_baseline(findings: List[Finding], path: str,
+                   root: str) -> List[Finding]:
+    from collections import Counter
+    budget = Counter(load_baseline(path))
+    out: List[Finding] = []
+    for f in findings:
+        k = baseline_key(f, root)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def write_baseline(findings: List[Finding], path: str,
+                   root: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("# fmlint baseline — one `relpath|rule|message` per "
+                 "accepted pre-existing finding.\n"
+                 "# Regenerate with: python -m tools.fmlint "
+                 "--update-baseline\n")
+        for f in findings:
+            fh.write(baseline_key(f, root) + "\n")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def project_root_for(paths: Sequence[str]) -> str:
+    """The root baseline keys are computed against — the same
+    common-directory derivation the project loader uses, so a baseline
+    written by ``--update-baseline`` matches what ``run_paths``
+    applies."""
+    from tools.fmlint.project import package_root
+    dirs = [os.path.dirname(os.path.abspath(f))
+            for f in collect_files(paths)]
+    return package_root(os.path.commonpath(dirs)) if dirs \
+        else os.getcwd()
 
 
 def default_paths() -> List[str]:
-    """The repo's lint surface when run with no arguments: the whole
-    package (each rule scopes itself to the modules it governs)."""
-    here = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    return [os.path.join(here, "fast_tffm_tpu")]
+    """The repo's lint surface when run with no arguments: the package,
+    the tools, and the CLI entry points (each rule scopes itself to the
+    modules it governs; the whole surface gets the R999 parse gate and
+    the cross-file rules)."""
+    here = repo_root()
+    return [os.path.join(here, "fast_tffm_tpu"),
+            os.path.join(here, "tools"),
+            os.path.join(here, "run_tffm.py"),
+            os.path.join(here, "bench.py")]
+
+
+def default_baseline_path() -> Optional[str]:
+    p = os.path.join(repo_root(), "tools", "fmlint", "baseline.txt")
+    return p if os.path.isfile(p) else None
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
+    as_json = update = False
+    baseline = default_baseline_path()
+    paths: List[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a == "--json":
+            as_json = True
+        elif a == "--update-baseline":
+            update = True
+        elif a == "--no-baseline":
+            baseline = None
+        elif a == "--baseline":
+            i += 1
+            if i >= len(args):
+                print("fmlint: --baseline needs a path",
+                      file=sys.stderr)
+                return 2
+            baseline = args[i]
+        else:
+            paths.append(a)
+        i += 1
     try:
-        findings = run_paths(args or default_paths())
+        findings = run_paths(paths or default_paths(),
+                             baseline=None if update else baseline)
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
         return 2
-    for f in findings:
-        print(f.render())
+    if update:
+        target = baseline or os.path.join(repo_root(), "tools",
+                                          "fmlint", "baseline.txt")
+        write_baseline(findings, target,
+                       project_root_for(paths or default_paths()))
+        print(f"fmlint: wrote {len(findings)} baseline entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to {target}",
+              file=sys.stderr)
+        return 0
+    if as_json:
+        import json
+        print(json.dumps({
+            "findings": [dataclasses.asdict(f) for f in findings],
+            "count": len(findings)}, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
     if findings:
         print(f"fmlint: {len(findings)} finding(s)", file=sys.stderr)
         return 1

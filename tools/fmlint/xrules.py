@@ -1,0 +1,505 @@
+"""fmlint whole-program rules (R007-R010) over tools/fmlint/project.py.
+
+These are the bug classes PRs 3-5's reviews kept catching by hand —
+whole-program properties no per-file syntactic rule can see:
+
+R007  divergent collective: a call that may (transitively) execute a
+      blocking collective is reachable under one arm of a branch
+      conditioned on process rank, with no matching collective on the
+      other arm — the multi-host deadlock (peers never post the
+      matching call; the exact bug PR 4's review caught in the restore
+      walk-back).
+R008  unsynchronized shared mutation: an instance attribute or module
+      global written from a function the thread summary proves can run
+      on a spawned thread, without holding a lock — the data race that
+      multiplies as the perf roadmap adds threads.
+R009  config/knob drift: every knob in config.py's section tables must
+      appear in sample.cfg AND the README; FM_* env fallbacks must map
+      to a real knob name; unknown keys in sample.cfg and unknown
+      ``cfg.<attr>`` reads are findings — the doc/schema rot the
+      [Cluster]/[Train] knob additions kept reintroducing.
+R010  unwrapped hot-path IO: a raw ``open()`` in the pipeline/
+      checkpoint hot modules that neither goes through utils/retry
+      (``open_with_retry`` / ``retry_io`` / ``@retrying``) nor sits
+      under an explicit OSError-family handler — IO with no failure
+      contract on exactly the paths transient NFS errors hit.
+
+Each rule returns standard Findings, so the pragma grammar and the
+baseline mechanism apply unchanged. Precision policy: the engine's
+summaries UNDERCLAIM (tools/fmlint/project.py docstring) — a finding
+here is evidence, and the sweep fixing or pragma-justifying every one
+is part of the rule's contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import configparser
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.fmlint.core import Finding
+from tools.fmlint.project import (COLLECTIVE_NAMES, FunctionInfo,
+                                  Project, resolve_call)
+
+# --- shared helpers --------------------------------------------------------
+
+_BLOCK_FIELDS = ("body", "orelse", "finalbody")
+
+
+def _own_expr_nodes(stmt) -> Iterable[ast.AST]:
+    """Every AST node belonging to ``stmt`` itself — headers and inline
+    expressions — excluding nested statement blocks (those are walked
+    as statements in their own right)."""
+    for field, value in ast.iter_fields(stmt):
+        if field in _BLOCK_FIELDS or field == "handlers":
+            continue
+        vals = value if isinstance(value, list) else [value]
+        for v in vals:
+            if isinstance(v, ast.AST):
+                yield from ast.walk(v)
+
+
+def _walk_skip_defs(node) -> Iterable[ast.AST]:
+    """Walk ``node`` without descending into nested function/class
+    bodies: defining a function executes nothing."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# --- R007: divergent collective -------------------------------------------
+
+_RANK_TOKENS = frozenset({"process_index", "process_id", "rank",
+                          "shard_index"})
+
+
+def _is_sanitizing(proj: Project, fn: FunctionInfo, expr) -> bool:
+    """A value produced BY a collective is rank-uniform by
+    construction — ``cand = self._broadcast_int(cand)`` is the
+    agreement primitive, not a divergence source."""
+    for n in ast.walk(expr):
+        if not isinstance(n, ast.Call):
+            continue
+        base = None
+        if isinstance(n.func, ast.Name):
+            base = n.func.id
+        elif isinstance(n.func, ast.Attribute):
+            base = n.func.attr
+        if base in COLLECTIVE_NAMES:
+            return True
+        callee = resolve_call(proj, fn, n.func)
+        if callee is not None and proj.collectives_of(callee):
+            return True
+    return False
+
+
+def _taint_assigns(fn: FunctionInfo
+                   ) -> List[Tuple[int, ast.AST, ast.AST]]:
+    """(lineno, target, value) for every simple assignment in source
+    order. Tuple assignments pair elementwise so ``p, P =
+    jax.process_index(), jax.process_count()`` can taint only ``p``."""
+    out: List[Tuple[int, ast.AST, ast.AST]] = []
+    for n in _walk_skip_defs(fn.node):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1:
+            t, v = n.targets[0], n.value
+            if (isinstance(t, ast.Tuple) and isinstance(v, ast.Tuple)
+                    and len(t.elts) == len(v.elts)):
+                out.extend((n.lineno, te, ve)
+                           for te, ve in zip(t.elts, v.elts))
+            else:
+                out.append((n.lineno, t, v))
+    return sorted(out, key=lambda x: x[0])
+
+
+def _tainted_at(proj: Project, fn: FunctionInfo,
+                assigns: Sequence[Tuple[int, ast.AST, ast.AST]],
+                line: int) -> Set[str]:
+    """Replay assignments in source order up to ``line``: a value
+    mentioning a rank token (or an already-tainted name) taints its
+    target — ``proc0 = jax.process_index() == 0`` — and a value routed
+    through a collective KILLS the taint (the broadcast result is the
+    agreed, rank-uniform value). Linear source order stands in for
+    control flow; good enough for the assign-then-branch shapes this
+    rule polices."""
+    tainted: Set[str] = set()
+    for lineno, t, v in assigns:
+        if lineno >= line:
+            break
+        if not isinstance(t, ast.Name):
+            continue
+        if _is_sanitizing(proj, fn, v):
+            tainted.discard(t.id)
+        elif _mentions_rank(v, tainted):
+            tainted.add(t.id)
+    return tainted
+
+
+def _mentions_rank(expr, tainted: Set[str] = frozenset()) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and (n.id in _RANK_TOKENS
+                                        or n.id in tainted):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RANK_TOKENS:
+            return True
+    return False
+
+
+def _arm_collectives(proj: Project, fn: FunctionInfo,
+                     stmts: Sequence[ast.stmt]) -> Set[str]:
+    """Collective kinds that MAY execute somewhere in ``stmts``:
+    direct calls plus anything the call graph proves a callee may
+    reach."""
+    kinds: Set[str] = set()
+    for stmt in stmts:
+        for n in _walk_skip_defs(stmt):
+            if not isinstance(n, ast.Call):
+                continue
+            base = None
+            if isinstance(n.func, ast.Name):
+                base = n.func.id
+            elif isinstance(n.func, ast.Attribute):
+                base = n.func.attr
+            if base in COLLECTIVE_NAMES:
+                kinds.add(base)
+            callee = resolve_call(proj, fn, n.func)
+            if callee is not None:
+                kinds |= proj.collectives_of(callee)
+    return kinds
+
+
+def _terminates(stmts: Sequence[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def r007_divergent_collective(proj: Project) -> List[Finding]:
+    found: List[Finding] = []
+    for fn in proj.functions.values():
+        assigns = _taint_assigns(fn)
+        for block in _statement_blocks(fn.node):
+            for i, stmt in enumerate(block):
+                if not isinstance(stmt, ast.If):
+                    continue
+                tainted = _tainted_at(proj, fn, assigns, stmt.lineno)
+                if not _mentions_rank(stmt.test, tainted):
+                    continue
+                arm_t: List[ast.stmt] = list(stmt.body)
+                arm_f: List[ast.stmt] = list(stmt.orelse)
+                tail = list(block[i + 1:])
+                # An arm that returns/raises diverts the OTHER arm
+                # into the block's tail: `if rank != 0: return` then a
+                # collective below is rank-divergent too.
+                if _terminates(arm_t) and not _terminates(arm_f):
+                    arm_f = arm_f + tail
+                elif _terminates(arm_f) and not _terminates(arm_t):
+                    arm_t = arm_t + tail
+                kt = _arm_collectives(proj, fn, arm_t)
+                kf = _arm_collectives(proj, fn, arm_f)
+                diff = sorted((kt - kf) | (kf - kt))
+                if not diff:
+                    continue
+                found.append(Finding(
+                    "R007", fn.module.path, stmt.lineno,
+                    f"collective(s) {', '.join(diff)} reachable on only "
+                    "one arm of a rank-conditioned branch "
+                    f"(in {fn.qualname.rsplit('.', 1)[-1]}): processes "
+                    "on the other arm never post the matching call and "
+                    "the cluster deadlocks; hoist the collective out "
+                    "of the branch, give the other arm its matching "
+                    "call, or justify with a pragma"))
+    return found
+
+
+def _statement_blocks(func_node) -> Iterable[List[ast.stmt]]:
+    """Every statement list in the function body — the function's own
+    blocks only, not nested defs'."""
+    out: List[List[ast.stmt]] = []
+
+    def visit_block(stmts: List[ast.stmt]):
+        out.append(stmts)
+        for s in stmts:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for field in _BLOCK_FIELDS:
+                sub = getattr(s, field, None)
+                if sub:
+                    visit_block(sub)
+            for h in getattr(s, "handlers", []) or []:
+                visit_block(h.body)
+
+    visit_block(list(func_node.body))
+    return out
+
+
+# --- R008: unsynchronized shared mutation ----------------------------------
+
+def r008_unsynchronized_shared_mutation(proj: Project) -> List[Finding]:
+    found: List[Finding] = []
+    for q in sorted(proj.thread_funcs):
+        fn = proj.functions.get(q)
+        if fn is None or fn.name == "__init__":
+            continue
+        for w in fn.shared_writes:
+            if w.locked:
+                continue
+            found.append(Finding(
+                "R008", fn.module.path, w.line,
+                f"'{w.target}' is mutated in {fn.name}(), which the "
+                "thread summary shows can run on a spawned thread, "
+                "without holding a lock; serialize on the owning lock "
+                "(`with self._lock:`), or justify a single-writer / "
+                "GIL-atomic design with a pragma"))
+    return found
+
+
+# --- R009: config/knob drift ----------------------------------------------
+
+_SECTION_BY_DICT = {"_GENERAL_KEYS": "General", "_TRAIN_KEYS": "Train",
+                    "_PREDICT_KEYS": "Predict",
+                    "_CLUSTER_KEYS": "Cluster"}
+
+
+def _config_schema(mod) -> Tuple[Dict[str, Dict[str, int]], Set[str]]:
+    """From config.py's AST: per-section {knob: definition line} from
+    the ``_*_KEYS`` tables, and the full FmConfig attribute surface
+    (fields + properties/methods) for the cfg.<attr> read check."""
+    sections: Dict[str, Dict[str, int]] = {}
+    surface: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            section = _SECTION_BY_DICT.get(node.targets[0].id)
+            if section and isinstance(node.value, ast.Dict):
+                keys = sections.setdefault(section, {})
+                for k in node.value.keys:
+                    if isinstance(k, ast.Constant) and isinstance(
+                            k.value, str):
+                        keys[k.value] = k.lineno
+        elif isinstance(node, ast.ClassDef) and node.name == "FmConfig":
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name):
+                    surface.add(item.target.id)
+                elif isinstance(item, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    surface.add(item.name)
+    return sections, surface
+
+
+def _word_in(text: str, word: str) -> bool:
+    return re.search(rf"\b{re.escape(word)}\b", text) is not None
+
+
+def _cfg_key_line(cfg_text: str, section: str, key: str) -> int:
+    """Line of an assigned (non-comment) key in an INI file, for
+    anchoring unknown-key findings."""
+    in_section = False
+    for i, line in enumerate(cfg_text.splitlines(), start=1):
+        s = line.strip()
+        if s.startswith("["):
+            in_section = s == f"[{section}]"
+        elif in_section and re.match(
+                rf"{re.escape(key)}\s*[=:]", s):
+            return i
+    return 0
+
+
+def r009_config_drift(proj: Project) -> List[Finding]:
+    cfg_mod = proj.module_at("fast_tffm_tpu/config.py")
+    if cfg_mod is None:
+        return []
+    root = os.path.dirname(os.path.dirname(cfg_mod.path))
+    sample_path = os.path.join(root, "sample.cfg")
+    readme_path = os.path.join(root, "README.md")
+    sections, surface = _config_schema(cfg_mod)
+    knobs = {k for keys in sections.values() for k in keys}
+    found: List[Finding] = []
+
+    sample_text = readme_text = None
+    if os.path.isfile(sample_path):
+        with open(sample_path, "r", encoding="utf-8") as fh:
+            sample_text = fh.read()
+    if os.path.isfile(readme_path):
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            readme_text = fh.read()
+
+    # 1. every knob documented in sample.cfg and the README
+    for section, keys in sorted(sections.items()):
+        for knob, line in sorted(keys.items()):
+            if sample_text is not None and not _word_in(sample_text,
+                                                        knob):
+                found.append(Finding(
+                    "R009", cfg_mod.path, line,
+                    f"[{section}] knob '{knob}' is not documented in "
+                    "sample.cfg; add it (a value or a commented "
+                    "default) so the quick-start config can't drift "
+                    "from the schema"))
+            if readme_text is not None and not _word_in(readme_text,
+                                                        knob):
+                found.append(Finding(
+                    "R009", cfg_mod.path, line,
+                    f"[{section}] knob '{knob}' is not documented in "
+                    "the README; add it to the config-reference table"))
+
+    # 2. unknown keys actually set in sample.cfg
+    if sample_text is not None:
+        cp = configparser.ConfigParser(
+            inline_comment_prefixes=(";", "#"))
+        try:
+            cp.read_string(sample_text)
+        except configparser.Error:
+            cp = None
+        if cp is not None:
+            for section in cp.sections():
+                known = sections.get(section)
+                if known is None:
+                    continue
+                for key in cp.options(section):
+                    if key not in known:
+                        found.append(Finding(
+                            "R009", sample_path,
+                            _cfg_key_line(sample_text, section, key),
+                            f"sample.cfg sets unknown [{section}] key "
+                            f"'{key}' — config.py would reject it at "
+                            "load time; fix the key or add it to the "
+                            "schema"))
+
+    # 3. FM_* env fallbacks must map to a real knob name
+    for read in proj.env_reads:
+        expect = read.var[len("FM_"):].lower()
+        if expect not in knobs:
+            found.append(Finding(
+                "R009", read.path, read.line,
+                f"env fallback '{read.var}' does not map to any config "
+                f"knob ('{expect}' is not in config.py's section "
+                "tables); FM_<KNOB> must stay consistent with its knob "
+                "name"))
+
+    # 4. cfg.<attr> reads against the FmConfig surface (package
+    # modules only — `cfg` is FmConfig by convention there)
+    pkg_prefix = os.path.dirname(cfg_mod.path) + os.sep
+    extra_ok = {os.path.join(root, "run_tffm.py"),
+                os.path.join(root, "bench.py")}
+    for read in proj.knob_reads:
+        if read.obj != "cfg" or read.attr.startswith("_"):
+            continue
+        if not (read.path.startswith(pkg_prefix)
+                or read.path in extra_ok):
+            continue
+        if surface and read.attr not in surface:
+            found.append(Finding(
+                "R009", read.path, read.line,
+                f"cfg.{read.attr} is not a knob, property, or method "
+                "of FmConfig — a renamed/removed knob left a stale "
+                "reader (frozen dataclass: this raises at runtime)"))
+    return found
+
+
+# --- R010: unwrapped hot-path IO ------------------------------------------
+
+R010_MODULE_SUFFIXES = ("fast_tffm_tpu/data/pipeline.py",
+                        "fast_tffm_tpu/checkpoint.py")
+
+# A handler for any of these has an explicit contract for the failing
+# open — the checkpoint sidecars' degrade-to-a-verdict pattern.
+_OSERROR_FAMILY = frozenset({"OSError", "IOError", "EnvironmentError",
+                             "FileNotFoundError", "PermissionError",
+                             "Exception", "BaseException"})
+_RETRY_NAMES = frozenset({"open_with_retry", "retry_io"})
+
+
+def _handles_oserror(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for n in ast.walk(t):
+        if isinstance(n, ast.Name):
+            names.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            names.append(n.attr)
+    return any(n in _OSERROR_FAMILY for n in names)
+
+
+def _stmt_mentions_retry(stmt) -> bool:
+    for n in _own_expr_nodes(stmt):
+        if isinstance(n, ast.Name) and n.id in _RETRY_NAMES:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _RETRY_NAMES:
+            return True
+    return False
+
+
+def _decorated_retrying(node) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        for n in ast.walk(dec):
+            if isinstance(n, ast.Name) and n.id == "retrying":
+                return True
+            if isinstance(n, ast.Attribute) and n.attr == "retrying":
+                return True
+    return False
+
+
+def r010_unwrapped_io(proj: Project) -> List[Finding]:
+    found: List[Finding] = []
+    for mod in proj.by_path.values():
+        p = mod.path.replace("\\", "/")
+        if not p.endswith(R010_MODULE_SUFFIXES):
+            continue
+
+        def walk_stmts(stmts, protected: bool, retried: bool):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    walk_stmts(stmt.body, protected,
+                               retried or _decorated_retrying(stmt))
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    walk_stmts(stmt.body, protected, retried)
+                    continue
+                exempt = (protected or retried
+                          or _stmt_mentions_retry(stmt))
+                for n in _own_expr_nodes(stmt):
+                    if (isinstance(n, ast.Call)
+                            and isinstance(n.func, ast.Name)
+                            and n.func.id == "open"
+                            and not exempt):
+                        found.append(Finding(
+                            "R010", mod.path, n.lineno,
+                            "raw open() on a pipeline/checkpoint hot "
+                            "path bypasses utils/retry — a transient "
+                            "NFS/object-store error kills the run; "
+                            "use open_with_retry/retry_io, handle "
+                            "OSError explicitly, or justify with a "
+                            "pragma"))
+                if isinstance(stmt, ast.Try):
+                    prot = protected or any(_handles_oserror(h)
+                                            for h in stmt.handlers)
+                    walk_stmts(stmt.body, prot, retried)
+                    for h in stmt.handlers:
+                        walk_stmts(h.body, protected, retried)
+                    walk_stmts(stmt.orelse, protected, retried)
+                    walk_stmts(stmt.finalbody, protected, retried)
+                    continue
+                for field in _BLOCK_FIELDS:
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        walk_stmts(sub, protected, retried)
+
+        walk_stmts(mod.tree.body, False, False)
+    return found
+
+
+PROGRAM_RULES = (r007_divergent_collective,
+                 r008_unsynchronized_shared_mutation,
+                 r009_config_drift,
+                 r010_unwrapped_io)
