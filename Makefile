@@ -6,6 +6,8 @@
 #   make            build the parser extension
 #   make test       run the test suite
 #   make bench      run the benchmark (one JSON line)
+#   make bench-host standalone host-only 1/2/4-worker sweep of the
+#                   parallel data plane (no device needed)
 #   make lint       fmlint whole-program pass (R000-R010) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
@@ -28,6 +30,9 @@ test: $(SO)
 bench: $(SO)
 	python bench.py
 
+bench-host: $(SO)
+	JAX_PLATFORMS=cpu python bench.py --host-sweep
+
 lint:
 	python -m tools.fmlint
 
@@ -37,4 +42,4 @@ chaos: $(SO)
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench lint chaos clean
+.PHONY: all test bench bench-host lint chaos clean

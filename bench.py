@@ -14,7 +14,10 @@ between rounds, the same JSON carries the attribution breakdown:
 
 - ``e2e_trials``: every end-to-end trial (spread = environment noise),
 - ``host_only``: pipeline-only rate (file -> C++ parse -> dedup -> padded
-  batch, device never touched) — the input-bound ceiling,
+  batch, device never touched) — the input-bound ceiling, measured at
+  the e2e-chosen ``host_threads``; ``host_only_workers`` carries the
+  1/2/4-worker sweep of the parallel host data plane (also standalone:
+  ``python bench.py --host-sweep`` / ``make bench-host``),
 - ``device_only``: jitted-step rate on one cached resident batch (no host
   work, no transfer) — the compute-bound ceiling,
 - ``h2d_only``: device_put rate for one batch's actual payload (raw-ids
@@ -67,9 +70,25 @@ import numpy as np
 NORTH_STAR_PER_CHIP = 1e9 / 3600.0 / 64.0  # examples/sec/chip
 
 
-def _cparser_threads() -> int:
+def _parse_threads() -> int:
+    """The C++ builder's NATIVE feed parse-thread count — a different
+    axis from the pipeline's ``host_threads`` build workers. Earlier
+    rounds reported this value AS ``host_threads`` (BENCH_r05), which
+    made the artifact claim a build parallelism the pipeline didn't
+    have; the JSON now carries both, correctly named."""
     from fast_tffm_tpu.data import cparser
     return cparser.auto_threads()
+
+
+def _with_workers(cfg, host_threads):
+    """The same bench config at an explicit data-plane worker count."""
+    import dataclasses
+    return dataclasses.replace(cfg, host_threads=host_threads)
+
+
+# The parallel-plane sweep points: 1 (the serial pre-parallel path),
+# 2, and 4 (the auto cap).
+HOST_WORKER_SWEEP = (1, 2, 4)
 
 B = 8192
 N_WARM, N_TIMED = 4, 40
@@ -541,6 +560,16 @@ def main():
         spec = ModelSpec.from_config(cfg)
         step = make_train_step(spec)
 
+        # e2e regime search over the parallel host data plane: one
+        # quick trial per worker count picks the best host_threads;
+        # the headline then runs its full TRIALS there, and the
+        # host_only ceiling is measured at the same setting (the
+        # ceiling must describe the loop the headline actually ran).
+        search = {w: run_e2e(_with_workers(cfg, w), step, n_warm=3)
+                  for w in HOST_WORKER_SWEEP}
+        best_workers = max(search, key=search.get)
+        cfg = _with_workers(cfg, best_workers)
+
         tel = _make_bench_telemetry(cfg)
         from fast_tffm_tpu.obs.telemetry import activate
         try:
@@ -551,6 +580,15 @@ def main():
                 # overhead.
                 e2e = [run_e2e(cfg, step) for _ in range(TRIALS)]
                 host = run_host_only(cfg)
+            # The 1/2/4-worker host_only sweep: the parallel plane's
+            # scaling artifact (1 = the serial pre-parallel pipeline).
+            # Every point runs OUTSIDE the activate() block — mixing
+            # one instrumented measurement (the ceiling above pays the
+            # telemetry overhead deliberately) into the sweep would
+            # bias the scaling ratio against the instrumented point.
+            host_workers = {
+                str(w): run_host_only(_with_workers(cfg, w))
+                for w in HOST_WORKER_SWEEP}
             dev = run_device_only(cfg, step)
             h2d = run_h2d_only(cfg)
             # Per-worker input rate of the 2-way byte-range sharded
@@ -615,12 +653,16 @@ def main():
                          "k16": k16_res.get("regime"),
                          "l64": l64_res.get("regime")},
         "e2e_trials": [round(v, 1) for v in e2e],
-        # BatchBuilder feed parse threads, read from the C++ library (1
-        # when the extension is unavailable and the generic Python path
-        # runs); >1 means the host_only ceiling reflects the threaded
-        # builder.
-        "host_threads": _cparser_threads(),
+        # The pipeline's ACTUAL build parallelism (data-plane workers,
+        # chosen by the e2e regime search) vs the C++ builder's native
+        # feed parse threads — two different axes; r05 conflated them.
+        "host_threads": best_workers,
+        "host_threads_search": {str(w): round(v, 1)
+                                for w, v in search.items()},
+        "parse_threads": _parse_threads(),
         "host_only": round(host, 1),
+        "host_only_workers": {w: round(v, 1)
+                              for w, v in host_workers.items()},
         "device_only": round(dev, 1),
         "h2d_only": round(h2d, 1),
         "sharded_input_per_worker": round(shard, 1),
@@ -655,11 +697,42 @@ def main():
     }))
 
 
+def host_sweep_main():
+    """Standalone host-only worker sweep (`make bench-host` /
+    `python bench.py --host-sweep`): the parallel data plane's
+    1/2/4-worker batch-build rates on the headline corpus shape, no
+    device required (raw_ids=False keeps the measurement on the
+    host-dedup build — the one multi-process mode must sustain — and
+    off any jitted-spec resolution). One JSON line, same spirit as the
+    main artifact: the 4v1 ratio is the scaling claim, attributable."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "train.txt")
+        lines = synth_lines((N_WARM + N_TIMED) * B, 1 << 20)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        del lines
+        cfg = make_cfg(path)
+        rates = {str(w): round(run_host_only(_with_workers(cfg, w),
+                                             raw_ids=False), 1)
+                 for w in HOST_WORKER_SWEEP}
+    print(json.dumps({
+        "metric": "host_only_examples_per_sec",
+        "unit": "examples/sec",
+        "host_only_workers": rates,
+        "scaling_4v1": round(rates["4"] / rates["1"], 3)
+        if rates.get("1") else None,
+        "parse_threads": _parse_threads(),
+    }))
+
+
 if __name__ == "__main__":
     import sys
     if len(sys.argv) > 1 and sys.argv[1] == "--line":
         if len(sys.argv) != 4:
             raise SystemExit("usage: bench.py --line <name> <train_path>")
         _line_main(sys.argv[2], sys.argv[3])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--host-sweep":
+        host_sweep_main()
     else:
         main()

@@ -75,6 +75,15 @@ class FmConfig:
     # LOOKAHEAD: this many batches are prepared ahead of the device
     # (prefetch_depth clamps it to [2, 8]).
     shuffle_threads: int = 1
+    # Parallel host data plane (README "Data plane"): batch-build
+    # workers fanning the parse->hash->dedup->pack stage across host
+    # cores behind a bounded ORDERED ring — the emitted batch stream is
+    # bit-identical to host_threads = 1 for the same config/seed, so
+    # this is a pure throughput knob. 0 = auto (min(4, host cores));
+    # 1 = the serial pipeline (pre-parallel behavior). Resolved by
+    # data/pipeline.resolve_host_threads; distinct from the C++
+    # builder's internal feed parse threads (bench reports both).
+    host_threads: int = 0
     shuffle: bool = True
     seed: int = 0
     adagrad_init: float = 0.1       # TF Adagrad accumulator init default
@@ -309,6 +318,10 @@ class FmConfig:
             raise ValueError(
                 f"max_bad_fraction must be in [0, 1], got "
                 f"{self.max_bad_fraction}")
+        if self.host_threads < 0:
+            raise ValueError(
+                f"host_threads must be >= 0 (0 = auto, 1 = serial), "
+                f"got {self.host_threads}")
         if self.io_retries < 0:
             raise ValueError(
                 f"io_retries must be >= 0 (0 = fail fast), got "
@@ -419,6 +432,7 @@ _TRAIN_KEYS = {
     "loss_type": str,
     "queue_size": int,
     "shuffle_threads": int,
+    "host_threads": int,
     "shuffle": bool,
     "seed": int,
     "adagrad_init": float,

@@ -595,8 +595,52 @@ extern "C" {
 // 3 = raw_ids builder mode (dedup=device); 4 = keep_empty builder mode
 // (blank line -> zero-feature example; the predict path's line
 // alignment); 5 = fm_bb_new num_threads param (threaded streaming
-// feed: parallel parse into a pending queue + serial drain).
-int64_t fm_abi_version() { return 5; }
+// feed: parallel parse into a pending queue + serial drain); 6 =
+// fm_scan_examples (example-boundary scanner for the parallel host
+// data plane's per-batch line groups).
+int64_t fm_abi_version() { return 6; }
+
+// Scan complete lines of [blob, blob+blob_len) until `n_target` lines
+// that PRODUCE AN EXAMPLE have been seen. The counting rule must equal
+// the BatchBuilder's exactly (is_ws over the same table): a line whose
+// bytes are all separator whitespace is blank — skipped by the builder
+// unless keep_empty, where every line becomes an example. Returns the
+// count found (<= n_target); *consumed_out = bytes through the LAST
+// counted line's newline (trailing blanks stay unconsumed — they
+// belong to the next group); *lines_out = total lines (blanks
+// included) inside those consumed bytes. A trailing partial line is
+// never consumed. This is the parallel data plane's group cutter
+// (data/pipeline._GroupScanner): memchr-speed, so the coordinator can
+// slice per-batch groups without Python ever touching lines.
+int64_t fm_scan_examples(const char* blob, int64_t blob_len,
+                         int64_t n_target, int keep_empty,
+                         int64_t* consumed_out, int64_t* lines_out) {
+  const char* p = blob;
+  const char* end = blob + blob_len;
+  int64_t found = 0, lines = 0;
+  int64_t mark = 0, mark_lines = 0;  // end of the last COUNTED line
+  while (p < end && found < n_target) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', size_t(end - p)));
+    if (nl == nullptr) break;  // partial line: next chunk's problem
+    lines++;
+    bool counting = keep_empty != 0;
+    if (!counting) {
+      const char* q = p;
+      while (q < nl && is_ws(*q)) q++;
+      counting = q != nl;
+    }
+    if (counting) {
+      found++;
+      mark = (nl + 1) - blob;
+      mark_lines = lines;
+    }
+    p = nl + 1;
+  }
+  *consumed_out = mark;
+  *lines_out = mark_lines;
+  return found;
+}
 
 // The auto ("num_threads = 0") parse-thread count, exported so Python
 // reports the value this library actually uses instead of re-deriving

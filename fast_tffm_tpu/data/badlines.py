@@ -88,11 +88,12 @@ class BadLineTracker:
         self._next_emit = 1     # power-of-two health-event schedule
         self._quarantined: Set[Tuple[str, int]] = set()
         self._q_fh = None
+        self._breaker: Optional[BadInputError] = None
         # The tracker is run-scoped and fed from prefetch PRODUCER
-        # threads; an abandoned producer (evaluate breaking out at
-        # validation_max_batches) can briefly overlap the next
-        # epoch's, so the counters and the quarantine handle serialize
-        # here rather than losing updates.
+        # threads AND the parallel data plane's build workers (several
+        # concurrent recorders per run is now the normal case, not the
+        # brief-overlap exception), so the counters, the quarantine
+        # handle, and the breaker all serialize here.
         self._lock = threading.Lock()
 
     @classmethod
@@ -118,6 +119,14 @@ class BadLineTracker:
         from fast_tffm_tpu.obs.telemetry import active
         tel = active()
         with self._lock:
+            if self._breaker is not None:
+                # The breaker TRIPS ONCE: under the parallel data
+                # plane several workers can cross the threshold
+                # near-simultaneously, and each must surface the SAME
+                # stored diagnosis (same worst file, same counts) —
+                # not re-count lines past the abort or mint competing
+                # error texts.
+                raise self._breaker
             self.total += 1
             self.bad += 1
             self.by_file[path] = self.by_file.get(path, 0) + 1
@@ -157,7 +166,7 @@ class BadLineTracker:
                 and self.bad / self.total > self.max_bad_fraction):
             worst, n_worst = max(self.by_file.items(),
                                  key=lambda kv: kv[1])
-            raise BadInputError(
+            self._breaker = BadInputError(
                 f"aborting: {self.bad} of {self.total} input lines "
                 f"({self.bad / self.total:.2%}) are malformed, over "
                 f"the max_bad_fraction ceiling "
@@ -167,6 +176,7 @@ class BadLineTracker:
                 "bad_line_policy = quarantine) or raise "
                 "max_bad_fraction if this corruption level is "
                 "expected.")
+            raise self._breaker
 
     def describe(self) -> str:
         frac = self.bad / self.total if self.total else 0.0

@@ -62,7 +62,7 @@ def _build() -> None:
 
 # Must equal fm_abi_version() in _parser.cc. Bump both together whenever
 # an exported signature changes.
-_ABI_VERSION = 5
+_ABI_VERSION = 6
 
 
 def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
@@ -76,6 +76,7 @@ def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
         lib.fm_auto_threads
         lib.fm_parse_block
         lib.fm_dedup_ids
+        lib.fm_scan_examples
         lib.fm_bb_new
         lib.fm_bb_feed
         lib.fm_bb_finish
@@ -157,6 +158,12 @@ def _load() -> ctypes.CDLL:
             np.ctypeslib.ndpointer(np.int32),             # uniq out
             np.ctypeslib.ndpointer(np.int32),             # inverse out
         ]
+        lib.fm_scan_examples.restype = ctypes.c_int64
+        lib.fm_scan_examples.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,              # blob, length
+            ctypes.c_int64, ctypes.c_int,                 # n_target, keep
+            ctypes.POINTER(ctypes.c_int64),               # out: consumed
+            ctypes.POINTER(ctypes.c_int64)]               # out: lines
         lib.fm_bb_new.restype = ctypes.c_void_p
         lib.fm_bb_new.argtypes = [ctypes.c_int64, ctypes.c_int64,
                                   ctypes.c_int64, ctypes.c_int,
@@ -253,6 +260,29 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
                        fields=fields[:z].copy() if field_aware else None)
 
 
+def scan_examples(data: bytes, n_target: int, keep_empty: bool = False,
+                  offset: int = 0) -> "tuple[int, int, int]":
+    """Count example-producing lines in the COMPLETE lines of
+    ``data[offset:]`` up to ``n_target``, without parsing: returns
+    ``(found, bytes_consumed, lines_consumed)`` where ``bytes_consumed``
+    ends at the last counted line's newline (relative to ``offset``)
+    and ``lines_consumed`` includes the blank lines inside that span.
+    The counting rule is the BatchBuilder's own (C++ ``is_ws``), so the
+    parallel data plane's group cutter and the builder can never
+    disagree about which lines fill a batch. Raises RuntimeError when
+    the extension is unusable. Zero-copy via pointer arithmetic, like
+    BatchBuilder.feed."""
+    lib = _load()
+    base = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p).value
+    consumed = ctypes.c_int64(0)
+    nlines = ctypes.c_int64(0)
+    found = lib.fm_scan_examples(ctypes.c_void_p((base or 0) + offset),
+                                 len(data) - offset, n_target,
+                                 int(keep_empty), ctypes.byref(consumed),
+                                 ctypes.byref(nlines))
+    return int(found), int(consumed.value), int(nlines.value)
+
+
 def parse_lines_salvage(lines: Sequence[str], vocabulary_size: int,
                         hash_feature_id: bool = False,
                         field_aware: bool = False, field_num: int = 0,
@@ -275,6 +305,12 @@ def parse_lines_salvage(lines: Sequence[str], vocabulary_size: int,
     ``keep_empty`` blocks skip the C++ attempt outright (the block
     parser has no blank-line-preserving mode; pipeline._parse_block
     makes the same routing choice).
+
+    Pool-safe: every buffer here is per-call, the C++ block parser
+    holds no global state, and the telemetry counters go through the
+    locked registry — the parallel data plane calls this concurrently
+    from its build workers (one bad block's Python retry runs on the
+    worker that hit it, not a shared salvage structure).
     """
     if bad_lines is None:
         bad_lines = []
@@ -306,6 +342,13 @@ class BatchBuilder:
     labels [B], uniq [n_uniq] with slot 0 = pad_id, local_idx [B, L],
     vals [B, L] — and resets for the next batch. One parse pass does
     parse + hash + dedup + padded scatter; there is no per-line Python.
+
+    Concurrency contract (the parallel host data plane relies on it):
+    the C++ library keeps ALL state per handle — distinct builders on
+    distinct threads never share anything, so a pool of workers each
+    OWNING one builder is safe, and every ctypes call releases the GIL
+    for its duration. A single handle is NOT internally locked; one
+    builder must stay owned by one thread at a time.
     """
 
     def __init__(self, batch_size: int, max_cols: int,

@@ -22,13 +22,15 @@ Padding invariants (relied on by ops/ and tests):
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 import glob as globlib
 import os
 import random
 import re
-from typing import Iterator, List, Optional, Sequence, Tuple
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -620,6 +622,589 @@ def _attach_block_source(e: ParseError,
     return ParseError(f"{_resolve_source(provenance[i])}: {m.group(2)}")
 
 
+def _host_cpus() -> int:
+    """Usable host cores, cgroup/cpuset-aware — the ONE counting rule
+    behind the auto host_threads resolution, the per-worker feed-thread
+    decision, and prefetch's GIL-bound passthrough gate (three callers
+    that must never disagree about what 'the host has N cores'
+    means)."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:
+        return os.cpu_count() or 1
+
+
+def resolve_host_threads(cfg: FmConfig) -> int:
+    """The parallel data plane's CONFIGURED batch-build worker count:
+    ``host_threads`` as set, or — 0 (auto) — min(4, host cores). 1
+    keeps the serial path, byte-for-byte the pre-parallel behavior.
+    Whether a given input actually fans out additionally depends on
+    routing (C++ availability, weight sidecars, ...): use
+    ``host_parallel_workers`` for the honest per-input answer."""
+    n = int(getattr(cfg, "host_threads", 0))
+    if n > 0:
+        return n
+    return max(1, min(4, _host_cpus()))
+
+
+def host_parallel_workers(cfg: FmConfig, weight_files: Sequence[str] = (),
+                          keep_empty: bool = False,
+                          fixed_shape: bool = False) -> int:
+    """The worker count the data plane will ACTUALLY use for these
+    inputs — resolve_host_threads when a parallel route exists (the
+    C++ fast path, or the tolerant generic path minus its serial-only
+    features), else 1. This is the SAME predicate _batch_iterator_impl
+    routes on, shared so train's startup log (and any other reporter)
+    can never claim a fan-out the pipeline won't perform."""
+    workers = resolve_host_threads(cfg)
+    if workers <= 1:
+        return 1
+    from fast_tffm_tpu.data import cparser
+    if not cparser.available():
+        return 1
+    if _fast_path_eligible(cfg, weight_files):
+        return workers
+    if (getattr(cfg, "bad_line_policy", "error") != "error"
+            and not keep_empty and not weight_files and not fixed_shape):
+        return workers  # tolerant generic plane
+    return 1
+
+
+def _worker_feed_threads(workers: int, spill_capable: bool) -> int:
+    """Feed parse threads per pool-worker builder. Spill-capable mode
+    (fixed U) REQUIRES the serial feed: the rewind protocol needs the
+    byte-exact consumed offset of a budget close, which the threaded
+    feed's pending queue hides. Otherwise give each worker 2 feed
+    threads when the host has cores to spare — the pool supplies the
+    main fan-out, this only shortens a single group's critical path."""
+    if spill_capable:
+        return 1
+    return 2 if _host_cpus() >= 2 * workers else 1
+
+
+def _make_builder(cfg: FmConfig, B: int, raw_ids: bool, keep_empty: bool,
+                  fixed_shape: bool, uniq_bucket: int,
+                  num_threads: int = 0):
+    """The ONE BatchBuilder construction, shared by the serial fast
+    path and the parallel plane's per-worker builders — a knob threaded
+    into one and missed in the other would silently fork the batch
+    contract between host_threads settings. Raises RuntimeError when
+    the C++ extension is unavailable (callers fall back generic)."""
+    from fast_tffm_tpu.data.cparser import BatchBuilder
+    # A ladder value (power of two past the top), so batches with
+    # max_features_per_example > ladder[-1] land in the same extended
+    # pow2 buckets the generic path compiles for.
+    L_cap = effective_L_cap(cfg)
+    return BatchBuilder(B, L_cap, cfg.vocabulary_size,
+                        hash_feature_id=cfg.hash_feature_id,
+                        field_aware=cfg.model_type == "ffm",
+                        field_num=cfg.field_num,
+                        raw_ids=raw_ids, keep_empty=keep_empty,
+                        max_features_per_example=(
+                            cfg.max_features_per_example),
+                        max_uniq=(uniq_bucket if fixed_shape else 0),
+                        num_threads=num_threads)
+
+
+class _BatchEmitter:
+    """Builder-output tuple -> DeviceBatch, plus the window-shuffle
+    drain: ONE implementation shared by the serial fast path and the
+    parallel ring coordinator. The host_threads=1 vs >1 bit-identical
+    parity guarantee rests on this being the same object — same rng
+    construction, same draw order per emitted batch, same window
+    bookkeeping — fed batches in the same stream order."""
+
+    def __init__(self, cfg: FmConfig, B: int, L_cap: int,
+                 fixed_shape: bool, uniq_bucket: int, shuffle: bool,
+                 seed: Optional[int], stats: Optional[SpillStats]):
+        self.cfg = cfg
+        self.B = B
+        self.L_cap = L_cap
+        self.fixed_shape = fixed_shape
+        self.uniq_bucket = uniq_bucket
+        self.shuffle = shuffle
+        self.stats = stats
+        self.pyrng = random.Random(cfg.seed if seed is None else seed)
+        self.nprng = np.random.default_rng(self.pyrng.getrandbits(64))
+        self.window: List[DeviceBatch] = []
+        self.window_cap = (max(2, cfg.queue_size // B) if shuffle
+                           else 1)
+
+    def emit_drain(self, out, spilled: bool) -> Iterator[DeviceBatch]:
+        """Emit one builder finish() tuple and drain through the
+        bounded shuffle window (a passthrough when shuffle is off)."""
+        batch = self._emit(*out, spilled=spilled)
+        if self.shuffle:
+            self.window.append(batch)
+            if len(self.window) >= self.window_cap:
+                yield self.window.pop(
+                    self.pyrng.randrange(len(self.window)))
+        else:
+            yield batch
+
+    def flush_window(self) -> Iterator[DeviceBatch]:
+        while self.window:
+            yield self.window.pop(
+                self.pyrng.randrange(len(self.window)))
+
+    def _emit(self, n, labels, uniq, li, vals, fields, max_nnz,
+              spilled: bool = False) -> DeviceBatch:
+        cfg, B = self.cfg, self.B
+        if self.stats is not None:
+            self.stats.count(n, B, spilled,
+                             num_uniq=_num_uniq(uniq, cfg.pad_id))
+        L = (self.L_cap if self.fixed_shape
+             else _ladder_fit(max(max_nnz, 1), cfg.bucket_ladder))
+        if L < self.L_cap:
+            li = np.ascontiguousarray(li[:, :L])
+            vals = np.ascontiguousarray(vals[:, :L])
+            if fields is not None:
+                fields = np.ascontiguousarray(fields[:, :L])
+        if uniq is None:  # raw-ids mode: li holds raw ids, no unique set
+            uniq_ids = None
+        else:
+            if self.fixed_shape and self.uniq_bucket:
+                U = self.uniq_bucket  # builder guarantees len(uniq) <= U
+            else:
+                uladder = _uniq_ladder(B, L)
+                # The builder's uniq already CONTAINS the reserved pad
+                # slot (index 0), unlike the generic path's real-ids-only
+                # set — fitting len+1 here would double-reserve and
+                # inflate U to the next rung exactly at boundaries
+                # (2x gather/scatter width, and a fast-vs-generic shape
+                # divergence that defeats compile-cache reuse).
+                U = (uladder[-1] if self.fixed_shape
+                     else _ladder_fit(len(uniq), uladder))
+            uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
+            uniq_ids[:len(uniq)] = uniq  # slot 0 already pad_id (C++)
+        weights = np.zeros(B, np.float32)
+        weights[:n] = 1.0
+        labels[n:] = 0.0  # C++ buffer may hold stale labels past n
+        if self.shuffle and n > 1:
+            # Permute only the real rows: consumers rely on the padding
+            # block staying at the tail ([:num_real] slicing).
+            perm = np.concatenate([self.nprng.permutation(n),
+                                   np.arange(n, B)])
+            labels, weights = labels[perm], weights[perm]
+            li, vals = li[perm], vals[perm]
+            if fields is not None:
+                fields = fields[perm]
+        return DeviceBatch(labels=labels, weights=weights,
+                           uniq_ids=uniq_ids, local_idx=li, vals=vals,
+                           fields=fields, num_real=n)
+
+
+class _BuildRing:
+    """Bounded ORDERED ring between a pool of batch-build workers and
+    the consuming iterator — the fan-out/fan-in seam of the parallel
+    host data plane. ``submit(payload)`` assigns the next sequence
+    number; workers pull tasks FIFO, build outside the lock, and post
+    results keyed by sequence; ``wait(seq)`` hands the consumer exactly
+    the in-order stream. ``invalidate_after(seq)`` implements the
+    spill-rewind protocol: a generation bump discards every queued task
+    and completed-but-unconsumed result past ``seq``, and in-flight
+    stale work discards itself at post time (its captured generation no
+    longer matches) — speculative batches are dropped, never emitted.
+
+    Thread-safety: every shared mutation (task deque, result map,
+    generation, liveness counts) holds ``self._lock``; the condition
+    variable rides the same lock (fmlint R008 checks these
+    thread-reachable writes). Worker-local build state (the per-worker
+    BatchBuilder) lives in objects created inside each worker thread
+    and never shared. Workers are daemon threads named ``fm-build-<i>``
+    so their telemetry spans render as per-worker tracks in fmtrace;
+    ``close()`` stops and joins them (bounded), so an aborted run never
+    leaks the pool."""
+
+    def __init__(self, workers: int, depth: int, work,
+                 make_state=None):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._tasks: collections.deque = collections.deque()
+        self._results: Dict[int, tuple] = {}
+        self._gen = 0
+        self._next_seq = 0
+        self._stop = False
+        self._pool_error: Optional[BaseException] = None
+        self._alive = 0
+        self._started = 0
+        self._work = work
+        self._make_state = make_state
+        self.depth = max(int(depth), 2)
+        self.workers = int(workers)
+        self._threads: List[threading.Thread] = []
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker_main,
+                                 name=f"fm-build-{i}", daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    def submit(self, payload) -> int:
+        with self._lock:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._tasks.append((self._gen, seq, payload))
+            self._cv.notify_all()
+            return seq
+
+    def has(self, seq: int) -> bool:
+        with self._lock:
+            return seq in self._results
+
+    def occupancy(self) -> int:
+        """Completed-but-unconsumed results parked in the ring — the
+        occupancy gauge (full ring = consumer-bound, empty = builders
+        can't keep up)."""
+        with self._lock:
+            return len(self._results)
+
+    def wait(self, seq: int) -> tuple:
+        """Block until ``seq``'s result is ready and take it:
+        ("ok", value) or ("error", exception). Raises instead when the
+        pool itself is unusable (a worker's state factory failed, or
+        every worker exited) — the consumer must never park forever on
+        a ring nobody will fill."""
+        with self._lock:
+            while True:
+                res = self._results.pop(seq, None)
+                if res is not None:
+                    return res
+                if self._pool_error is not None:
+                    raise self._pool_error
+                if self._started >= self.workers and self._alive == 0:
+                    raise RuntimeError(
+                        "all batch-build workers exited; the host "
+                        "data plane cannot make progress")
+                self._cv.wait()
+
+    def invalidate_after(self, seq: int) -> None:
+        with self._lock:
+            self._gen += 1
+            self._tasks.clear()
+            self._results = {s: r for s, r in self._results.items()
+                             if s <= seq}
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def _worker_main(self) -> None:
+        from fast_tffm_tpu.obs.telemetry import active
+        from fast_tffm_tpu.obs.trace import span
+        import time as _time
+        try:
+            state = (self._make_state()
+                     if self._make_state is not None else None)
+        except BaseException as e:  # builder creation failed: poison
+            with self._lock:
+                self._started += 1
+                self._pool_error = e
+                self._cv.notify_all()
+            return
+        with self._lock:
+            self._started += 1
+            self._alive += 1
+        try:
+            while True:
+                with self._lock:
+                    while not self._tasks and not self._stop:
+                        self._cv.wait()
+                    if self._stop:
+                        return
+                    gen, seq, payload = self._tasks.popleft()
+                tel = active()
+                try:
+                    if tel is None:
+                        res = ("ok", self._work(state, payload))
+                    else:
+                        # fmlint: disable=R003 -- feeds the pipeline/
+                        # worker_build_seconds counter (per-worker
+                        # aggregate; the build_worker span beside it is
+                        # the timeline view)
+                        t0 = _time.perf_counter()
+                        with span("pipeline/build_worker"):
+                            res = ("ok", self._work(state, payload))
+                        # fmlint: disable=R003 -- closes the sample
+                        tel.count("pipeline/worker_build_seconds",
+                                  _time.perf_counter() - t0)
+                except BaseException as e:  # delivered at wait(seq)
+                    res = ("error", e)
+                with self._lock:
+                    if gen == self._gen:
+                        self._results[seq] = res
+                        self._cv.notify_all()
+        finally:
+            with self._lock:
+                self._alive -= 1
+                self._cv.notify_all()
+
+
+class _Group:
+    """One dispatched line group: the raw bytes of exactly one batch's
+    worth of example-producing lines (newline-terminated), plus its
+    stream provenance — the count of stream lines before it and inside
+    it (for error rebasing and spill rewind)."""
+
+    __slots__ = ("blob", "line_start", "lines")
+
+    def __init__(self, blob: bytes, line_start: int, lines: int):
+        self.blob = blob
+        self.line_start = line_start
+        self.lines = lines
+
+
+class _GroupScanner:
+    """Cuts the shard's byte stream into per-batch line groups for the
+    parallel fast plane — the deterministic interleave the pool fans
+    out over.
+
+    Group invariant: every non-final group holds exactly B
+    example-producing lines by the BUILDER'S OWN counting rule
+    (cparser.scan_examples shares the C++ blank-line table), is
+    newline-terminated, and never splits a line — so feeding it to a
+    fresh-state builder yields exactly the batch the serial builder
+    would emit at that stream position. The scan is memchr-speed C++;
+    Python here only slices blobs and walks 4 MB chunks, so the
+    coordinator thread stays far faster than the parse it feeds.
+
+    ``file_spans`` and the consumed-line counter mirror the serial
+    path's error-provenance map (_attach_stream_source); ``pushback``
+    is the spill-rewind entry: unconsumed bytes return to the stream
+    head and the line counter rewinds with them, so re-cut groups get
+    the same line numbers they would have had serially."""
+
+    def __init__(self, files: Sequence[str], shard_index: int,
+                 num_shards: int, B: int, keep_empty: bool,
+                 retry: Optional[RetryPolicy]):
+        self._files = list(files)
+        self._fi = 0
+        self._chunks: Optional[Iterator[bytes]] = None
+        self._buf = b""
+        self._pos = 0
+        self._B = B
+        self._keep_empty = keep_empty
+        self._retry = retry
+        self._si, self._ns = shard_index, num_shards
+        self.lines = 0  # stream lines consumed into groups so far
+        self.file_spans: List[Tuple[int, str, int, int]] = []
+
+    def pushback(self, blob: bytes, line_start: int) -> None:
+        self._buf = blob + self._buf[self._pos:]
+        self._pos = 0
+        self.lines = line_start
+
+    def next_group(self) -> Optional[_Group]:
+        from fast_tffm_tpu.data.cparser import scan_examples
+        while True:
+            found, consumed, nlines = scan_examples(
+                self._buf, self._B, self._keep_empty, offset=self._pos)
+            if found >= self._B:
+                return self._cut(consumed, nlines)
+            chunk = self._next_chunk()
+            if chunk is None:
+                if found:
+                    g = self._cut(consumed, nlines)
+                else:
+                    g = None
+                # Trailing blank lines (never example-producing) are
+                # dropped — the serial path feeds them to the builder,
+                # which skips them with no observable effect.
+                self._buf = b""
+                self._pos = 0
+                return g
+            self._buf = self._buf[self._pos:] + chunk
+            self._pos = 0
+
+    def _cut(self, consumed: int, nlines: int) -> _Group:
+        g = _Group(self._buf[self._pos:self._pos + consumed],
+                   self.lines, nlines)
+        self._pos += consumed
+        self.lines += nlines
+        return g
+
+    def _next_chunk(self) -> Optional[bytes]:
+        while True:
+            if self._chunks is not None:
+                chunk = next(self._chunks, None)
+                if chunk is not None:
+                    return chunk
+                self._chunks = None
+                # File exhausted: terminate a newline-less final line
+                # so its group cuts exactly where the serial path's
+                # `feed(tail + b"\n")` would.
+                tail = self._buf[self._pos:]
+                if tail and not tail.endswith(b"\n"):
+                    return b"\n"
+            if self._fi >= len(self._files):
+                return None
+            path = self._files[self._fi]
+            self._fi += 1
+            start, end = shard_byte_range(path, self._si, self._ns)
+            # Lines before this file = lines already consumed into
+            # groups + complete lines still buffered (all from earlier
+            # files; a newline-less tail was terminated above) — the
+            # serial path's fed_lines at the same stream point.
+            base = self.lines + self._buf.count(b"\n", self._pos)
+            self.file_spans.append((base, path, start, end))
+            self._chunks = _iter_owned_chunks(path, start, end,
+                                              retry=self._retry)
+
+
+class _FastWorkerState:
+    """Per-worker build state: ONE BatchBuilder owned by one pool
+    thread (the per-worker builder ownership the C++ concurrency
+    contract requires), plus a mirror of its internal line counter for
+    rebasing builder-relative error linenos onto the stream. Created
+    inside the worker thread and never shared."""
+
+    def __init__(self, make_builder):
+        self._make_builder = make_builder
+        self.bb = make_builder()
+        self.fed = 0  # lines consumed by self.bb since creation
+
+    def reset(self) -> None:
+        # After a parse error the builder holds a half-built batch and
+        # an unrecoverable line counter; a fresh builder restores both
+        # invariants (the old handle frees via __del__).
+        self.bb = self._make_builder()
+        self.fed = 0
+
+
+def _fast_group_work(state: _FastWorkerState, group: _Group):
+    """Build ONE group (one batch's worth of lines) on a pool worker.
+    Returns ``(finish_tuple, bytes_consumed)``; ``consumed <
+    len(blob)`` IS the spill signal — the builder closed the batch
+    early on the unique budget and left the offending line unconsumed,
+    so the coordinator must rewind. ParseErrors rebase from
+    builder-relative to stream-relative line numbers HERE, where the
+    group's line offset is known; the coordinator then attaches file
+    provenance exactly like the serial path."""
+    bb = state.bb
+    fed_before = state.fed
+    try:
+        _full, consumed = bb.feed(group.blob, 0)
+        out = bb.finish()
+    except ParseError as e:
+        state.reset()
+        m = _LINE_MSG.match(str(e))
+        if m:
+            k = int(m.group(1)) - fed_before
+            raise ParseError(
+                f"line {group.line_start + k}: {m.group(2)}") from None
+        raise
+    state.fed += (group.lines if consumed >= len(group.blob)
+                  else group.blob[:consumed].count(b"\n"))
+    return out, consumed
+
+
+def _parallel_fast_batch_iterator(cfg: FmConfig, files: List[str],
+                                  B: int, n_epochs: int, shuffle: bool,
+                                  seed: Optional[int],
+                                  fixed_shape: bool, shard_index: int,
+                                  num_shards: int, uniq_bucket: int,
+                                  stats: Optional[SpillStats],
+                                  raw_ids: bool, keep_empty: bool,
+                                  workers: int
+                                  ) -> Iterator[DeviceBatch]:
+    """Parallel host data plane, fast path: parse+hash+dedup+pack fans
+    out across ``workers`` pool threads — each owning its own C++
+    BatchBuilder — over a deterministic per-batch interleave of the
+    shard's line groups; finished batches re-serialize through a
+    bounded ordered ring (_BuildRing) that the existing prefetch() H2D
+    stage drains.
+
+    Parity guarantee (pinned by tests/test_parallel_pipeline.py): the
+    emitted batch stream is BIT-IDENTICAL to ``host_threads = 1`` for
+    the same config/seed. The load-bearing pieces:
+
+    - groups are cut at example boundaries by the builder's own
+      counting rule (_GroupScanner), so group k's lines are exactly
+      serial batch k's lines;
+    - each group meets a fresh-state builder (finish() resets; the C++
+      library clears row buffers per batch), so batch arrays cannot
+      depend on which worker built them or what it built before;
+    - batches re-serialize in group order, and all shuffle-window/rng
+      work happens in the shared _BatchEmitter on the consuming side —
+      same rng, same draw order as serial;
+    - a unique-budget spill (fixed-U mode) invalidates every in-flight
+      group past it and re-cuts from the spilled line — the serial
+      stream's requeue replayed at group granularity; speculative work
+      is discarded, never emitted (spills cost a little wasted build,
+      never correctness, mirroring the spill protocol's own contract).
+    """
+    from fast_tffm_tpu.obs.telemetry import active
+    spill_capable = bool(fixed_shape and uniq_bucket)
+    feed_threads = _worker_feed_threads(workers, spill_capable)
+    make_builder = functools.partial(_make_builder, cfg, B, raw_ids,
+                                     keep_empty, fixed_shape,
+                                     uniq_bucket, feed_threads)
+    emitter = _BatchEmitter(cfg, B, effective_L_cap(cfg), fixed_shape,
+                            uniq_bucket, shuffle, seed, stats)
+    retry = RetryPolicy.from_config(cfg)
+    file_seed = cfg.seed if seed is None else seed
+    ring = _BuildRing(workers, depth=2 * workers,
+                      work=_fast_group_work,
+                      make_state=lambda: _FastWorkerState(make_builder))
+    tel = active()
+    if tel is not None:
+        tel.set("pipeline/host_threads", workers)
+    try:
+        for epoch in range(n_epochs):
+            scanner = _GroupScanner(
+                epoch_file_order(files, shuffle, file_seed, epoch),
+                shard_index, num_shards, B, keep_empty, retry)
+            inflight: Dict[int, _Group] = {}
+            order: collections.deque = collections.deque()
+            scan_done = False
+            while True:
+                while not scan_done and len(inflight) < ring.depth:
+                    g = scanner.next_group()
+                    if g is None:
+                        scan_done = True
+                        break
+                    s = ring.submit(g)
+                    inflight[s] = g
+                    order.append(s)
+                if not order:
+                    break
+                s = order.popleft()
+                g = inflight.pop(s)
+                kind, payload = ring.wait(s)
+                if tel is not None:
+                    tel.set("pipeline/ring_occupancy",
+                            ring.occupancy())
+                if kind == "error":
+                    if isinstance(payload, ParseError):
+                        raise _attach_stream_source(
+                            payload, scanner.file_spans,
+                            num_shards) from None
+                    raise payload
+                out, consumed = payload
+                spilled = consumed < len(g.blob)
+                yield from emitter.emit_drain(out, spilled)
+                if spilled:
+                    # Rewind: the unconsumed tail of this group plus
+                    # every in-flight group after it returns to the
+                    # scanner, which re-cuts from the spilled line —
+                    # exactly the lines the serial builder would open
+                    # the next batch with.
+                    lines_used = g.blob[:consumed].count(b"\n")
+                    leftover = g.blob[consumed:] + b"".join(
+                        inflight[t].blob for t in order)
+                    ring.invalidate_after(s)
+                    inflight.clear()
+                    order.clear()
+                    scanner.pushback(leftover,
+                                     g.line_start + lines_used)
+                    scan_done = False
+            yield from emitter.flush_window()
+    finally:
+        ring.close()
+
+
 def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                          n_epochs: int, shuffle: bool,
                          seed: Optional[int], fixed_shape: bool,
@@ -644,65 +1229,14 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
     With ``uniq_bucket`` (fixed_shape multi-process mode) the builder
     caps each batch's unique rows; a too-dense batch closes early with
     n < B real examples (the spill protocol) and shapes stay constant.
+
+    Emission (stats counting, window shuffle, per-batch row
+    permutation) is the shared _BatchEmitter — the same code the
+    parallel plane's ring coordinator runs, which is what makes
+    ``host_threads`` a pure throughput knob (bit-identical streams).
     """
-    L_cap = bb.L
-    pyrng = random.Random(cfg.seed if seed is None else seed)
-    nprng = np.random.default_rng(pyrng.getrandbits(64))
-    window: List[DeviceBatch] = []
-    window_cap = max(2, cfg.queue_size // B) if shuffle else 1
-
-    def emit(n, labels, uniq, li, vals, fields, max_nnz,
-             spilled: bool = False) -> DeviceBatch:
-        if stats is not None:
-            stats.count(n, B, spilled,
-                        num_uniq=_num_uniq(uniq, cfg.pad_id))
-        L = (L_cap if fixed_shape
-             else _ladder_fit(max(max_nnz, 1), cfg.bucket_ladder))
-        if L < L_cap:
-            li = np.ascontiguousarray(li[:, :L])
-            vals = np.ascontiguousarray(vals[:, :L])
-            if fields is not None:
-                fields = np.ascontiguousarray(fields[:, :L])
-        if uniq is None:  # raw-ids mode: li holds raw ids, no unique set
-            uniq_ids = None
-        else:
-            if fixed_shape and uniq_bucket:
-                U = uniq_bucket  # builder guarantees len(uniq) <= U
-            else:
-                uladder = _uniq_ladder(B, L)
-                # The builder's uniq already CONTAINS the reserved pad
-                # slot (index 0), unlike the generic path's real-ids-only
-                # set — fitting len+1 here would double-reserve and
-                # inflate U to the next rung exactly at boundaries
-                # (2x gather/scatter width, and a fast-vs-generic shape
-                # divergence that defeats compile-cache reuse).
-                U = (uladder[-1] if fixed_shape
-                     else _ladder_fit(len(uniq), uladder))
-            uniq_ids = np.full(U, cfg.pad_id, dtype=np.int32)
-            uniq_ids[:len(uniq)] = uniq  # slot 0 already pad_id (C++)
-        weights = np.zeros(B, np.float32)
-        weights[:n] = 1.0
-        labels[n:] = 0.0  # C++ buffer may hold stale labels past n
-        if shuffle and n > 1:
-            # Permute only the real rows: consumers rely on the padding
-            # block staying at the tail ([:num_real] slicing).
-            perm = np.concatenate([nprng.permutation(n),
-                                   np.arange(n, B)])
-            labels, weights = labels[perm], weights[perm]
-            li, vals = li[perm], vals[perm]
-            if fields is not None:
-                fields = fields[perm]
-        return DeviceBatch(labels=labels, weights=weights,
-                           uniq_ids=uniq_ids, local_idx=li, vals=vals,
-                           fields=fields, num_real=n)
-
-    def drain(batch: DeviceBatch) -> Iterator[DeviceBatch]:
-        if shuffle:
-            window.append(batch)
-            if len(window) >= window_cap:
-                yield window.pop(pyrng.randrange(len(window)))
-        else:
-            yield batch
+    emitter = _BatchEmitter(cfg, B, bb.L, fixed_shape, uniq_bucket,
+                            shuffle, seed, stats)
 
     tail = b""
     fed_lines = 0       # complete lines fed to the builder so far —
@@ -726,7 +1260,7 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
             # The builder returns "full" either at B examples or when a
             # line would blow the unique budget — the latter closes the
             # batch short (the spill being counted).
-            yield from drain(emit(*out, spilled=out[0] < B))
+            yield from emitter.emit_drain(out, spilled=out[0] < B)
         tail = data[off:]  # unconsumed partial line, re-fed next chunk
 
     retry = RetryPolicy.from_config(cfg)
@@ -744,12 +1278,10 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                     yield from feed_all(tail + chunk if tail else chunk)
                 if tail:  # final owned line missing its newline
                     yield from feed_all(tail + b"\n")
-            n, labels, uniq, li, vals, fields, max_nnz = bb.finish()
-            if n:  # short final batch of the epoch
-                yield from drain(emit(n, labels, uniq, li, vals, fields,
-                                      max_nnz))
-            while window:
-                yield window.pop(pyrng.randrange(len(window)))
+            out = bb.finish()
+            if out[0]:  # short final batch of the epoch
+                yield from emitter.emit_drain(out, spilled=False)
+            yield from emitter.flush_window()
     except ParseError as e:
         raise _attach_stream_source(e, file_spans, num_shards) from None
 
@@ -917,22 +1449,24 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
     # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
     # no feature needs per-line Python handling — including sharded
     # multi-process input (byte ranges), field-aware FFM tokens, and
-    # keep_empty line alignment (predict).
+    # keep_empty line alignment (predict). With host_threads > 1 the
+    # same path fans out across the parallel data plane's worker pool
+    # (bit-identical stream; README "Data plane"). The routing
+    # predicate is host_parallel_workers — the SAME one train's
+    # startup log reports, so the log can't claim a fan-out this
+    # function won't perform.
+    workers = host_parallel_workers(cfg, weight_files, keep_empty,
+                                    fixed_shape)
     if _fast_path_eligible(cfg, weight_files):
+        if workers > 1:
+            yield from _parallel_fast_batch_iterator(
+                cfg, files, B, n_epochs, do_shuffle, seed, fixed_shape,
+                shard_index, num_shards, uniq_bucket, stats, raw_ids,
+                keep_empty, workers)
+            return
         try:
-            from fast_tffm_tpu.data.cparser import BatchBuilder
-            # A ladder value (power of two past the top), so batches with
-            # max_features_per_example > ladder[-1] land in the same
-            # extended pow2 buckets the generic path compiles for.
-            L_cap = effective_L_cap(cfg)
-            bb = BatchBuilder(B, L_cap, cfg.vocabulary_size,
-                              hash_feature_id=cfg.hash_feature_id,
-                              field_aware=cfg.model_type == "ffm",
-                              field_num=cfg.field_num,
-                              raw_ids=raw_ids, keep_empty=keep_empty,
-                              max_features_per_example=(
-                                  cfg.max_features_per_example),
-                              max_uniq=(uniq_bucket if fixed_shape else 0))
+            bb = _make_builder(cfg, B, raw_ids, keep_empty, fixed_shape,
+                               uniq_bucket)
         except RuntimeError:
             bb = None  # C++ extension unavailable -> generic path
         if bb is not None:
@@ -991,6 +1525,64 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
         w = np.array([c[1] for c in chunk], dtype=np.float32)
         return chunk, block, w
 
+    # Generic-path fan-out (tolerant bad-line policies): chunk
+    # composition is line-order-deterministic — a bad line drops from
+    # the parsed BLOCK, never shifts the B-line chunk boundaries — and
+    # with fixed_shape off no UniqOverflow can reorder the stream, so
+    # each chunk's parse+build is an independent task farmed to the
+    # pool and re-serialized in submit order (same bounded ordered
+    # ring as the fast plane). The run-scoped LOCKED tracker is shared
+    # by every worker, so the max_bad_fraction breaker and the
+    # quarantine (file, lineno) dedupe stay global; only the ORDER of
+    # quarantine records may interleave across workers — the set is
+    # identical, pinned by the parity tests. Weighted, keep_empty, and
+    # fixed-shape inputs stay serial (GIL-bound pairing, Python-parser
+    # blanks, and the spill-requeue's sequential composition).
+    pool: Optional[_BuildRing] = None
+    pool_order: collections.deque = collections.deque()
+    if tracker is not None and workers > 1:
+        # workers > 1 already folds in the route conditions (C++
+        # available, no weights/keep_empty/fixed_shape) via
+        # host_parallel_workers above.
+        def _pool_work(_state, payload):
+            raw_chunk, precounted = payload
+            chunk, block, w = parse_chunk(raw_chunk,
+                                          precounted=precounted)
+            if block.batch_size == 0:
+                return None  # every line of the chunk was bad
+            return make_device_batch(block, cfg, weights=w,
+                                     batch_size=B,
+                                     fixed_shape=fixed_shape,
+                                     uniq_bucket=uniq_bucket,
+                                     raw_ids=raw_ids)
+        pool = _BuildRing(workers, depth=2 * workers,
+                          work=_pool_work)
+        from fast_tffm_tpu.obs.telemetry import active as _active
+        _tel = _active()
+        if _tel is not None:
+            _tel.set("pipeline/host_threads", workers)
+
+    def pool_drain(limit: int) -> Iterator[DeviceBatch]:
+        """Yield completed pool batches in submit order: every
+        already-finished head eagerly, plus (blocking) enough to keep
+        the in-flight count within ``limit`` (0 = drain everything)."""
+        from fast_tffm_tpu.obs.telemetry import active as _active
+        tel = _active()
+        while pool_order and (len(pool_order) > limit
+                              or pool.has(pool_order[0])):
+            s = pool_order.popleft()
+            kind, val = pool.wait(s)
+            if tel is not None:
+                tel.set("pipeline/ring_occupancy", pool.occupancy())
+            if kind == "error":
+                raise val
+            if val is None:
+                continue  # all-bad chunk: nothing to emit
+            if stats is not None:
+                stats.count(val.num_real, B, False,
+                            num_uniq=_batch_num_uniq(val, cfg))
+            yield val
+
     file_seed = cfg.seed if seed is None else seed
     try:
         for epoch in range(n_epochs):
@@ -1006,6 +1598,10 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
                     del pending[:B]
                     k = min(requeue_counted[0], len(raw_chunk))
                     requeue_counted[0] -= k
+                    if pool is not None:
+                        pool_order.append(pool.submit((raw_chunk, k)))
+                        yield from pool_drain(pool.depth)
+                        continue
                     chunk, block, w = parse_chunk(raw_chunk,
                                                   precounted=k)
                     if tracker is not None and block.batch_size == 0:
@@ -1072,7 +1668,11 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
                 rng.shuffle(buf)
                 pending.extend(buf)
             yield from flush_batches(True)
+            if pool is not None:  # epoch barrier: ring fully drained
+                yield from pool_drain(0)
     finally:
+        if pool is not None:
+            pool.close()
         if own_tracker:
             tracker.close()
 
@@ -1240,12 +1840,7 @@ def prefetch(iterator: Iterator[DeviceBatch], depth: int = 2,
     that combination keeps the passthrough.
     """
     if gil_bound:
-        import os
-        try:
-            n_cpus = len(os.sched_getaffinity(0))  # cgroup/cpuset-aware
-        except AttributeError:
-            n_cpus = os.cpu_count() or 1
-        if n_cpus <= 1:
+        if _host_cpus() <= 1:
             yield from iterator
             return
 

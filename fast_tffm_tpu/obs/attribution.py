@@ -157,6 +157,17 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
         "pause_seconds": pauses,
         "pause_fraction": _frac(pauses, loop_s + pauses),
         "h2d_bytes_per_sec": _frac(h2d_bytes, loop_s),
+        # Parallel host data plane (README "Data plane"): configured
+        # build workers, their summed build seconds over the
+        # consumer-observed build+wait time (values near the worker
+        # count = the fan-out is real; near 1 = the plane added no
+        # overlap), and the ordered ring's last-seen occupancy (full =
+        # consumer-bound, empty = builders can't keep up).
+        "host_threads": g.get("pipeline/host_threads"),
+        "host_build_concurrency": _frac(
+            c.get("pipeline/worker_build_seconds"),
+            c.get("pipeline/build_seconds")),
+        "ring_occupancy": g.get("pipeline/ring_occupancy"),
         "dedup_hit_rate": dedup_hit_rate(c),
         "padding_waste_fraction": padding_waste(c),
         "parse_errors": c.get("pipeline/parse_errors", 0),
@@ -205,8 +216,19 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
     if loop_s <= 0:
         out["verdict"] = "no train-loop data"
     elif iw is not None and iw > HOST_BOUND_FRACTION:
+        # Host-parallel efficiency rides the host-bound verdict: a
+        # host-bound run whose build concurrency already matches its
+        # worker count needs MORE workers (or a faster parser); one
+        # far below it has idle workers — a different fix.
+        hp = ""
+        ht = out.get("host_threads")
+        conc = out.get("host_build_concurrency")
+        if ht:
+            hp = (f"; host_threads={ht:.0f}, build concurrency "
+                  f"{conc:.1f}x" if conc is not None
+                  else f"; host_threads={ht:.0f}")
         out["verdict"] = (f"host-bound: {iw:.0%} of the loop waits on "
-                          "the input pipeline")
+                          f"the input pipeline{hp}")
     elif pf is not None and pf > PAUSE_BOUND_FRACTION:
         out["verdict"] = (f"pause-bound: {pf:.0%} of run time in "
                           "checkpoint/summary/validation pauses")
@@ -456,6 +478,10 @@ def render(summary: Dict[str, Any]) -> str:
         ("input-wait fraction", att["input_wait_fraction"]),
         ("pause seconds (ckpt/summary/val)", att["pause_seconds"]),
         ("h2d bytes/sec", att["h2d_bytes_per_sec"]),
+        ("host threads / build concurrency",
+         f"{_fmt(att['host_threads'])} / "
+         f"{_fmt(att['host_build_concurrency'])}"),
+        ("ring occupancy (last)", att["ring_occupancy"]),
         ("dedup hit rate", att["dedup_hit_rate"]),
         ("padding-waste fraction", att["padding_waste_fraction"]),
         ("parse errors", att["parse_errors"]),

@@ -34,6 +34,80 @@ from fast_tffm_tpu.utils.logging import get_logger
 _DEPTH_BUCKETS = tuple(2 ** i for i in range(11))
 
 
+class _ScoreWriter:
+    """Ordered score-file writer on a small background thread, so the
+    next file's parse/score/D2H overlaps the previous file's disk
+    write instead of serializing behind it (the first bite of the
+    predict-gap roadmap item). Submission order IS write order (one
+    queue, one writer), the queue is bounded (at most 2 files' scores
+    buffered), and ``close()`` in the caller's finally flushes
+    everything and surfaces any deferred write error — a predict()
+    return means every score file is on disk. Each write is a
+    ``predict/write`` span on the ``fm-score-writer`` track in
+    fmtrace."""
+
+    def __init__(self, logger):
+        import queue
+        import threading
+        self._logger = logger
+        self._q: "queue.Queue" = queue.Queue(maxsize=2)
+        self._sentinel = object()
+        self._lock = threading.Lock()  # guards _error (worker writes,
+        # submit/close read; fmlint R008)
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._run,
+                                        name="fm-score-writer",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        from fast_tffm_tpu.obs.trace import span
+        while True:
+            job = self._q.get()
+            if job is self._sentinel:
+                return
+            with self._lock:
+                dead = self._error is not None
+            if dead:
+                # Drain-and-discard: the run is already doomed (the
+                # error surfaces at the next submit()/close()); keep
+                # unblocking producers, stop burning I/O on writes
+                # that would land beside a failed one.
+                continue
+            out_path, vals = job
+            try:
+                with span("predict/write",
+                          path=os.path.basename(out_path)):
+                    with open(out_path, "w") as fh:
+                        for v in vals:
+                            fh.write(f"{v:.6f}\n")
+                self._logger.info("wrote %d scores to %s", len(vals),
+                                  out_path)
+            except BaseException as e:  # surfaced at submit()/close()
+                with self._lock:
+                    if self._error is None:  # keep the FIRST failure
+                        self._error = e
+
+    def submit(self, out_path: str, vals: np.ndarray) -> None:
+        with self._lock:
+            err = self._error
+        if err is not None:
+            raise err
+        self._q.put((out_path, vals))
+
+    def close(self, raise_error: bool = True) -> None:
+        if not self._closed:
+            self._closed = True
+            self._q.put(self._sentinel)
+            self._thread.join()
+        if raise_error:
+            with self._lock:
+                err = self._error
+            if err is not None:
+                raise err
+
+
 def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
     """Restore the table from the latest checkpoint.
 
@@ -247,34 +321,40 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
         table = load_table(cfg, mesh)
     os.makedirs(cfg.score_path, exist_ok=True)
     written = []
-    for path in expand_files(cfg.predict_files):
-        # fmlint: disable=R003 -- feeds the predict/seconds counter and
-        # per-file rate gauge (always-on aggregates; the span beside it
-        # is the timeline view)
-        t0 = time.perf_counter()
-        with span("predict/file", path=os.path.basename(path)):
-            raw = predict_scores(cfg, table, [path], mesh=mesh,
-                                 backend=backend)
-        # fmlint: disable=R003 -- closes the predict/seconds sample
-        dt = time.perf_counter() - t0
-        vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
-        out_path = os.path.join(cfg.score_path,
-                                os.path.basename(path) + ".score")
-        with open(out_path, "w") as fh:
-            for v in vals:
-                fh.write(f"{v:.6f}\n")
-        logger.info("wrote %d scores to %s", len(vals), out_path)
-        written.append(out_path)
-        if tel is not None:
-            rate = len(raw) / dt if dt > 0 else 0.0
-            tel.count("predict/seconds", dt)
-            tel.set("predict/examples_per_sec", rate)
-            tel.sink.emit("predict_file",
-                          {"path": path, "examples": len(raw),
-                           "seconds": dt, "examples_per_sec": rate})
-            # Per-file barrier: scores are already host-side here, so
-            # the flush is pure file I/O.
-            tel.barrier_flush(step=len(written))
+    # Writer thread (see _ScoreWriter): file N's disk write overlaps
+    # file N+1's parse/score/D2H. The inner close() surfaces deferred
+    # write errors on the clean path; the finally's close is the
+    # idempotent no-mask flush for the error path.
+    writer = _ScoreWriter(logger)
+    try:
+        for path in expand_files(cfg.predict_files):
+            # fmlint: disable=R003 -- feeds the predict/seconds counter
+            # and per-file rate gauge (always-on aggregates; the span
+            # beside it is the timeline view)
+            t0 = time.perf_counter()
+            with span("predict/file", path=os.path.basename(path)):
+                raw = predict_scores(cfg, table, [path], mesh=mesh,
+                                     backend=backend)
+            # fmlint: disable=R003 -- closes the predict/seconds sample
+            dt = time.perf_counter() - t0
+            vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
+            out_path = os.path.join(cfg.score_path,
+                                    os.path.basename(path) + ".score")
+            writer.submit(out_path, vals)
+            written.append(out_path)
+            if tel is not None:
+                rate = len(raw) / dt if dt > 0 else 0.0
+                tel.count("predict/seconds", dt)
+                tel.set("predict/examples_per_sec", rate)
+                tel.sink.emit("predict_file",
+                              {"path": path, "examples": len(raw),
+                               "seconds": dt, "examples_per_sec": rate})
+                # Per-file barrier: scores are already host-side here,
+                # so the flush is pure file I/O.
+                tel.barrier_flush(step=len(written))
+        writer.close()
+    finally:
+        writer.close(raise_error=False)
     return written
 
 

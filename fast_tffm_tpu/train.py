@@ -26,7 +26,8 @@ from fast_tffm_tpu.config import FmConfig
 from fast_tffm_tpu.data.badlines import BadLineTracker
 from fast_tffm_tpu.data.pipeline import (SPILL_WARN_FRACTION, SpillStats,
                                          batch_iterator,
-                                         gil_bound_iteration, prefetch,
+                                         gil_bound_iteration,
+                                         host_parallel_workers, prefetch,
                                          uniq_bucket_top)
 from fast_tffm_tpu.utils.retry import RetryPolicy
 from fast_tffm_tpu.metrics import StreamingAUC
@@ -426,6 +427,18 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
 
     worker_lost = False
     try:
+        # Visibility only — the plane lives inside batch_iterator.
+        # host_parallel_workers is the SAME predicate the routing
+        # uses, so this log never claims a fan-out the pipeline won't
+        # perform for THIS run's inputs (C++ missing, weight sidecars,
+        # tolerant fixed-shape all route serial).
+        host_workers = host_parallel_workers(
+            cfg, cfg.weight_files, fixed_shape=multi_process)
+        if host_workers > 1:
+            logger.info(
+                "host data plane: %d parallel batch-build workers "
+                "(host_threads = %s; bounded ordered ring)",
+                host_workers, cfg.host_threads)
         uniq_bucket = 0
         if multi_process:
             # Fixed-shape batches need one U for the whole job. Auto mode

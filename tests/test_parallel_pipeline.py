@@ -1,0 +1,337 @@
+"""Parallel host data plane == serial data plane, bit for bit.
+
+The ``host_threads`` knob must be a PURE throughput knob: for the same
+config/seed, the multi-worker plane (group scanner -> worker pool ->
+bounded ordered ring -> shared emitter) must emit the byte-identical
+batch stream the serial pipeline emits — across the C++ fast path, the
+tolerant generic path, spill-requeued tails (fixed-U mode), weight
+sidecars, keep_empty, raw-ids mode, sharded input, multi-file
+multi-epoch shuffle, and error provenance. Plus: the pool must never
+leak worker threads (clean end OR abandoned iterator), and the
+4-worker build must actually scale (the tier-1 smoke the BENCH row
+pins locally)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data import cparser
+from fast_tffm_tpu.data.badlines import BadLineTracker
+from fast_tffm_tpu.data.parser import ParseError
+from fast_tffm_tpu.data.pipeline import (SpillStats, batch_iterator,
+                                         resolve_host_threads)
+
+needs_cpp = pytest.mark.skipif(not cparser.available(),
+                               reason="C++ parser extension unavailable")
+
+
+def _write(tmp_path, n=300, seed=1, name="d.txt", blanks=False,
+           nnz_hi=14, vocab=300):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        nnz = rng.integers(1, nnz_hi)
+        ids = rng.choice(vocab, size=nnz, replace=False)
+        lines.append(" ".join(["1" if rng.random() < 0.4 else "0"]
+                              + [f"{j}:{rng.random():.4f}" for j in ids]))
+        if blanks and i % 11 == 3:
+            lines.append("")   # blank line
+        if blanks and i % 29 == 7:
+            lines.append("   ")  # whitespace-only line
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _cfg(path, host_threads, **kw):
+    base = dict(vocabulary_size=300, factor_num=4, batch_size=16,
+                train_files=(path,), shuffle=False,
+                bucket_ladder=(4, 8, 16), max_features_per_example=16,
+                host_threads=host_threads)
+    base.update(kw)
+    return FmConfig(**base)
+
+
+def _key(b):
+    """Full byte identity of one DeviceBatch."""
+    return (b.labels.tobytes(), b.weights.tobytes(),
+            None if b.uniq_ids is None else b.uniq_ids.tobytes(),
+            b.local_idx.tobytes(), b.vals.tobytes(),
+            None if b.fields is None else b.fields.tobytes(),
+            b.num_real)
+
+
+def _stream(cfg, **kw):
+    return [_key(b) for b in batch_iterator(cfg, cfg.train_files,
+                                            training=True, **kw)]
+
+
+def _assert_parity(path, cfg_kw=None, it_kw=None):
+    cfg_kw, it_kw = cfg_kw or {}, it_kw or {}
+    a = _stream(_cfg(path, 1, **cfg_kw), **it_kw)
+    b = _stream(_cfg(path, 4, **cfg_kw), **it_kw)
+    assert len(a) == len(b) and a == b
+    return a
+
+
+@needs_cpp
+def test_fast_path_parity(tmp_path):
+    s = _assert_parity(_write(tmp_path))
+    assert len(s) == 19  # 300 examples / B=16
+
+
+@needs_cpp
+def test_fast_path_parity_shuffle(tmp_path):
+    # Shuffle rng (file order, window draws, per-batch row perms) is
+    # shared emitter code fed in ring order — identical draws.
+    p1 = _write(tmp_path, n=150, seed=2)
+    p2 = _write(tmp_path, n=90, seed=3, name="e.txt")
+    _assert_parity(p1, cfg_kw=dict(train_files=(p1, p2), shuffle=True,
+                                   seed=5, queue_size=64),
+                   it_kw=dict(epochs=2, seed=11))
+
+
+@needs_cpp
+def test_fast_path_parity_keep_empty(tmp_path):
+    s = _assert_parity(_write(tmp_path, blanks=True),
+                       it_kw=dict(keep_empty=True))
+    assert s  # blank lines became zero-feature examples in both
+
+
+@needs_cpp
+def test_fast_path_parity_raw_ids(tmp_path):
+    _assert_parity(_write(tmp_path), it_kw=dict(raw_ids=True))
+
+
+@needs_cpp
+def test_fast_path_parity_sharded(tmp_path):
+    path = _write(tmp_path, n=400, seed=4)
+    for shard in range(3):
+        _assert_parity(path, it_kw=dict(shard_index=shard,
+                                        num_shards=3))
+
+
+@needs_cpp
+def test_spill_requeued_tail_parity(tmp_path):
+    """Fixed-U mode: a unique-budget spill closes a batch early and the
+    tail reopens the next one — the parallel plane must replay that
+    requeue exactly (invalidate in-flight groups, re-cut from the
+    spilled line), with identical spill accounting."""
+    path = _write(tmp_path, n=500, seed=6, nnz_hi=16, vocab=3000)
+    stats = {}
+    streams = {}
+    for w in (1, 4):
+        cfg = _cfg(path, w, vocabulary_size=3000, batch_size=32)
+        st = SpillStats()
+        streams[w] = [_key(b) for b in batch_iterator(
+            cfg, cfg.train_files, training=True, fixed_shape=True,
+            uniq_bucket=128, stats=st)]
+        stats[w] = st
+    assert streams[1] == streams[4]
+    # The config is built to spill hard; if it stops spilling the test
+    # stops testing the rewind protocol — fail loudly instead.
+    assert stats[1].spilled_batches > 3
+    for f in ("batches", "spilled_batches", "real_examples", "max_uniq"):
+        assert getattr(stats[1], f) == getattr(stats[4], f), f
+
+
+@needs_cpp
+def test_weight_sidecar_parity(tmp_path):
+    # Weighted input pairs weights to lines in Python (GIL-bound): it
+    # stays on the serial plane at every host_threads — parity is the
+    # pin that the routing actually does that.
+    path = _write(tmp_path, n=120, seed=7)
+    wpath = tmp_path / "w.txt"
+    wpath.write_text("".join(f"{v:.3f}\n" for v in
+                             np.random.default_rng(0).uniform(
+                                 0.5, 2.0, 120)))
+    _assert_parity(path, it_kw=dict(weight_files=(str(wpath),)))
+
+
+@needs_cpp
+def test_quarantine_parity_and_global_dedupe(tmp_path):
+    """Tolerant generic plane: identical batch streams, and the
+    run-scoped tracker stays GLOBAL across workers — same bad/total
+    counts, same per-file attribution, and the quarantine sidecar
+    holds the same RECORD SET (order may interleave across workers;
+    each (file, lineno) exactly once even over 2 epochs)."""
+    path = _write(tmp_path, n=260, seed=8)
+    lines = open(path).read().splitlines()
+    for i in range(7, 260, 40):
+        lines[i] = f"##bad## {lines[i]}"
+    dirty = tmp_path / "dirty.txt"
+    dirty.write_text("\n".join(lines) + "\n")
+    results = {}
+    for w in (1, 4):
+        qfile = str(tmp_path / f"q{w}.jsonl")
+        tracker = BadLineTracker("quarantine", 0.5,
+                                 quarantine_file=qfile)
+        cfg = _cfg(str(dirty), w, bad_line_policy="quarantine",
+                   max_bad_fraction=0.5)
+        stream = [_key(b) for b in batch_iterator(
+            cfg, cfg.train_files, training=True, epochs=2,
+            bad_lines=tracker)]
+        tracker.close()
+        recs = [json.loads(ln) for ln in open(qfile) if ln.strip()]
+        results[w] = (stream, tracker.bad, tracker.total,
+                      dict(tracker.by_file),
+                      sorted((r["file"], r["lineno"], r["raw"])
+                             for r in recs))
+    assert results[1] == results[4]
+    assert results[1][1] == 2 * 7  # 7 bad lines, counted both epochs
+    assert len(results[1][4]) == 7  # quarantined ONCE across epochs
+
+
+@needs_cpp
+def test_parallel_generic_plane_actually_runs(tmp_path):
+    """The quarantine config above must really fan out: fm-build
+    workers alive while the iterator is draining."""
+    path = _write(tmp_path, n=200, seed=9)
+    cfg = _cfg(path, 4, bad_line_policy="quarantine")
+    it = batch_iterator(cfg, cfg.train_files, training=True)
+    next(it)
+    alive = [t.name for t in threading.enumerate()
+             if t.name.startswith("fm-build") and t.is_alive()]
+    it.close()
+    assert alive, "generic parallel plane never started its pool"
+
+
+@needs_cpp
+def test_error_provenance_parity(tmp_path):
+    """A bad line under policy=error must raise the SAME file/lineno
+    diagnosis from the parallel plane as from the serial one (worker
+    errors rebase builder-relative linenos onto the stream)."""
+    path = _write(tmp_path, n=90, seed=10)
+    lines = open(path).read().splitlines()
+    lines[61] = "notalabel 3:1"
+    bad = tmp_path / "bad.txt"
+    bad.write_text("\n".join(lines) + "\n")
+    msgs = {}
+    for w in (1, 4):
+        cfg = _cfg(str(bad), w)
+        with pytest.raises(ParseError) as ei:
+            list(batch_iterator(cfg, cfg.train_files, training=True))
+        msgs[w] = str(ei.value)
+    assert msgs[1] == msgs[4]
+    assert "line 62" in msgs[1] and "bad.txt" in msgs[1]
+
+
+@needs_cpp
+def test_no_worker_leak_on_completion_and_abandon(tmp_path):
+    path = _write(tmp_path, n=200, seed=12)
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t.name.startswith("fm-build") and t.is_alive()]
+
+    cfg = _cfg(path, 4)
+    list(batch_iterator(cfg, cfg.train_files, training=True))
+    assert not leaked()
+    # Abandoned mid-stream: generator close must stop and join the pool.
+    it = batch_iterator(cfg, cfg.train_files, training=True)
+    next(it)
+    it.close()
+    assert not leaked()
+
+
+def test_resolve_host_threads():
+    path_free = dict(vocabulary_size=8, batch_size=4)
+    assert resolve_host_threads(FmConfig(host_threads=3,
+                                         **path_free)) == 3
+    assert resolve_host_threads(FmConfig(host_threads=1,
+                                         **path_free)) == 1
+    auto = resolve_host_threads(FmConfig(host_threads=0, **path_free))
+    assert 1 <= auto <= 4
+    with pytest.raises(ValueError):
+        FmConfig(host_threads=-1, **path_free)
+
+
+def test_build_ring_orders_and_recovers():
+    """_BuildRing unit contract: results re-serialize in submit order
+    regardless of completion order; invalidate_after discards
+    speculative work; per-task errors surface at their seq; close
+    joins the pool."""
+    from fast_tffm_tpu.data.pipeline import _BuildRing
+    gate = threading.Event()
+
+    def work(_state, payload):
+        if payload == "slow":
+            gate.wait(5.0)
+        if payload == "boom":
+            raise ValueError("boom")
+        return payload
+
+    ring = _BuildRing(3, depth=8, work=work)
+    try:
+        s0 = ring.submit("slow")
+        s1 = ring.submit("fast1")
+        s2 = ring.submit("boom")
+        s3 = ring.submit("fast2")
+        # Later tasks finish first; wait(s0) must still block until s0.
+        deadline = time.monotonic() + 5.0
+        while not ring.has(s3) and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ring.has(s1) and ring.has(s3) and not ring.has(s0)
+        gate.set()
+        assert ring.wait(s0) == ("ok", "slow")
+        assert ring.wait(s1) == ("ok", "fast1")
+        kind, err = ring.wait(s2)
+        assert kind == "error" and isinstance(err, ValueError)
+        assert ring.wait(s3) == ("ok", "fast2")
+        # Invalidation: queued/unconsumed results past seq are dropped,
+        # and new submissions use fresh seqs.
+        s4 = ring.submit("a")
+        s5 = ring.submit("b")
+        ring.wait(s4)
+        ring.invalidate_after(s4)
+        s6 = ring.submit("c")
+        assert s6 > s5
+        assert ring.wait(s6) == ("ok", "c")
+        assert not ring.has(s5)
+    finally:
+        ring.close()
+    assert all(not t.is_alive() for t in ring._threads)
+
+
+@needs_cpp
+def test_parallel_build_scales(tmp_path):
+    """Tier-1 scaling smoke for the BENCH host_only row: the 4-worker
+    plane must beat the serial plane by >= 1.3x on a synthetic Criteo-
+    like corpus. Same-window INTERLEAVED paired ratios (the repo's A/B
+    doctrine — see test_threaded_builder_scales): each trial measures
+    W=1 and W=4 back to back and the best paired ratio decides, so
+    ambient load on a shared host can't flake the gate; the bar exists
+    to catch the plane accidentally SERIALIZING (~1.0x), not to pin
+    the ~2-3x a quiet multi-core box shows."""
+    if (os.cpu_count() or 1) < 4:
+        pytest.skip("needs >= 4 cores to measure scaling")
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(40000):
+        ids = rng.choice(100000, size=39, replace=False)
+        lines.append("1 " + " ".join(f"{j}:1.5" for j in ids))
+    path = tmp_path / "big.txt"
+    path.write_text("\n".join(lines) + "\n")
+
+    def rate(w):
+        cfg = FmConfig(vocabulary_size=100000, batch_size=8192,
+                       train_files=(str(path),), shuffle=False,
+                       max_features_per_example=48, bucket_ladder=(48,),
+                       host_threads=w)
+        n = 0
+        t0 = time.perf_counter()
+        for b in batch_iterator(cfg, cfg.train_files, training=True):
+            n += b.num_real
+        return n / (time.perf_counter() - t0)
+
+    ratios = []
+    for _ in range(4):
+        r1 = rate(1)
+        ratios.append(rate(4) / r1)
+    assert max(ratios) >= 1.3, (
+        f"W=4/W=1 paired ratios {[f'{r:.2f}' for r in ratios]}")

@@ -22,6 +22,15 @@ asserts the documented recovery behavior:
 - ``flaky-open``      the first 2 opens of the train file raise EIO →
                       the retry/backoff layer absorbs them; retry
                       counters land in the metrics stream.
+- ``flaky-open-parallel`` the same transient-open fault soaked under
+                      ``host_threads = 4`` (the parallel data plane):
+                      retries absorb identically, the run's metrics
+                      prove the worker pool actually ran, AND a
+                      10%-corrupt quarantine run through the parallel
+                      plane trips the ``max_bad_fraction`` breaker
+                      exactly once naming the worst file — with no
+                      ``fm-build`` worker threads leaked after the
+                      abort.
 - ``preempt-resume``  SIGTERM mid-epoch → the run saves and exits
                       cleanly, ``fmstat`` reports PREEMPTED (not
                       CRASHED); a restart resumes the interrupted
@@ -214,6 +223,63 @@ def scenario_flaky_open(workdir: str, seed: int = 0) -> str:
     assert _verdict(cfg) == "OK", _verdict(cfg)
     return (f"absorbed {state['failures']} injected open failures "
             f"({int(c['io/retries'])} retries in the metrics stream)")
+
+
+def scenario_flaky_open_parallel(workdir: str, seed: int = 0) -> str:
+    """The parallel host data plane under faults (host_threads=4):
+    IO retry/backoff and the max_bad_fraction breaker must behave
+    exactly as they do serially — retries absorbed, breaker trips
+    ONCE naming the worst file — and an aborted run must not leak
+    build-worker threads."""
+    import threading
+    from fast_tffm_tpu.data.badlines import BadInputError
+    from fast_tffm_tpu.testing.faults import corrupt_corpus, flaky_open
+    from fast_tffm_tpu.train import train
+
+    def leaked_workers():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith("fm-build") and t.is_alive()]
+
+    # Part 1: transient opens on the train file, absorbed by the
+    # retry layer while the 4-worker plane is driving the reads.
+    data = os.path.join(workdir, "train_flaky_par.txt")
+    _write_corpus(data, 2000, seed)
+    cfg = _cfg(workdir, data, io_retries=3, host_threads=4)
+    with flaky_open(2, match="train_flaky_par.txt") as state:
+        train(cfg)
+    assert state["failures"] == 2, state
+    c = _counters(cfg)
+    assert c.get("io/retries", 0) >= 2, c.get("io/retries")
+    # The pool really ran: per-worker build seconds only exist when
+    # groups were built on fm-build threads.
+    assert c.get("pipeline/worker_build_seconds", 0) > 0, c
+    assert _verdict(cfg) == "OK", _verdict(cfg)
+    assert not leaked_workers(), leaked_workers()
+
+    # Part 2: the breaker through the PARALLEL quarantine plane — own
+    # metrics file so the counters aren't folded into part 1's run.
+    subdir = os.path.join(workdir, "breaker")
+    os.makedirs(subdir, exist_ok=True)
+    clean = os.path.join(subdir, "clean.txt")
+    dirty = os.path.join(subdir, "train_rotten_par.txt")
+    _write_corpus(clean, 2000, seed)
+    corrupt_corpus(clean, dirty, fraction=0.10, seed=seed)
+    cfg2 = _cfg(subdir, dirty, bad_line_policy="quarantine",
+                host_threads=4)
+    try:
+        train(cfg2)
+    except BadInputError as e:
+        assert dirty in str(e), (
+            f"breaker error must name the worst file: {e}")
+        assert "max_bad_fraction" in str(e)
+        assert str(e).count("aborting:") == 1, str(e)
+    else:
+        raise AssertionError("max_bad_fraction breaker never tripped "
+                             "under the parallel plane")
+    assert not leaked_workers(), leaked_workers()
+    return ("parallel plane absorbed 2 injected open failures "
+            f"({int(c['io/retries'])} retries), breaker tripped once "
+            "naming the corrupt file, no fm-build threads leaked")
 
 
 def scenario_preempt_resume(workdir: str, seed: int = 0) -> str:
@@ -689,6 +755,7 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "quarantine": scenario_quarantine,
     "max-bad": scenario_max_bad,
     "flaky-open": scenario_flaky_open,
+    "flaky-open-parallel": scenario_flaky_open_parallel,
     "preempt-resume": scenario_preempt_resume,
     "truncate-latest": scenario_truncate_latest,
     "kill-async-save": scenario_kill_async_save,
