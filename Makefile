@@ -11,6 +11,8 @@
 #   make lint       fmlint whole-program pass (R000-R010) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
+#   make stream-soak  the streaming run-mode scenarios standalone
+#                   (torn writes / SIGTERM+resume / truncation)
 #   make clean
 
 CXX ?= g++
@@ -39,7 +41,10 @@ lint:
 chaos: $(SO)
 	JAX_PLATFORMS=cpu python -m tools.fmchaos
 
+stream-soak: $(SO)
+	JAX_PLATFORMS=cpu python -m tools.fmchaos stream-soak stream-truncate
+
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host lint chaos clean
+.PHONY: all test bench bench-host lint chaos stream-soak clean

@@ -58,9 +58,17 @@ _HASH_CHUNK_BYTES = 1 << 20
 # (_prune_sidecars) and fmckpt's offline scan share — a sidecar rename
 # updated in one place only would make the offline tool delete files
 # the run still needs, or miss real orphans. Matches epoch overrides,
-# manifests, and torn manifest .tmp files (a killed writer's litter).
+# manifests, stream watermarks, and torn .tmp files (a killed writer's
+# litter).
 SIDECAR_RE = re.compile(
-    r"(?:epoch_override-(\d+)|manifest-(\d+)\.json(?:\.tmp)?)")
+    r"(?:epoch_override-(\d+)|manifest-(\d+)\.json(?:\.tmp)?"
+    r"|watermark-(\d+)\.json(?:\.tmp)?)")
+
+# Stream-mode publish pointer (README "Streaming / online learning"):
+# a tiny file in the .ckpt directory naming the newest PUBLISHED step —
+# atomically replaced, so a scorer watching it always reads a complete
+# value and can hot-reload the manifest-verified step it names.
+PUBLISHED_POINTER = "published"
 
 
 def sidecar_step(name: str) -> Optional[int]:
@@ -69,7 +77,7 @@ def sidecar_step(name: str) -> Optional[int]:
     m = SIDECAR_RE.fullmatch(name)
     if not m:
         return None
-    return int(m.group(1) or m.group(2))
+    return int(m.group(1) or m.group(2) or m.group(3))
 
 
 def manifest_path(directory: str, step: int) -> str:
@@ -83,6 +91,85 @@ def read_epoch_override(directory: str, step: int) -> Optional[int]:
     try:
         with open(os.path.join(directory,
                                f"epoch_override-{step}")) as fh:
+            return int(fh.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _atomic_write_text(path: str, data: str) -> None:
+    """The ONE tmp-write + fsync + rename sequence every sidecar
+    writer (manifest, epoch override, watermark, published pointer)
+    shares: the file either exists complete or not at all, and a
+    failed write never litters its .tmp (a hard kill still can — the
+    SIDECAR_RE orphan scans sweep those). Deliberately unretried:
+    save-side write failures must surface at the save site
+    (CheckpointState docstring)."""
+    tmp = path + ".tmp"
+    try:
+        # fmlint: disable=R010 -- save-side writes are deliberately
+        # never retried (CheckpointState docstring): a failed sidecar
+        # write must fail its save loudly, not mask a torn file
+        # behind backoff
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def watermark_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"watermark-{step}.json")
+
+
+def read_watermark(directory: str, step: int) -> Optional[dict]:
+    """The step's durable stream-position sidecar (run_mode = stream),
+    or None when the step has none (epoch-mode checkpoints never do).
+    A garbled sidecar also returns None, WITH a warning: resuming a
+    stream without its watermark re-reads from the beginning of every
+    tracked file — train() refuses that loudly rather than silently
+    double-training (see train's stream restore)."""
+    path = watermark_path(directory, step)
+    try:
+        # fmlint: disable=R010 -- missing IS the common case (every
+        # epoch-mode checkpoint) and a transiently unreadable sidecar
+        # must become the same "no watermark" verdict the caller
+        # handles, not a retry loop inside the restore decision
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (ValueError, OSError):
+        get_logger().warning(
+            "stream watermark sidecar %s is unreadable/garbled; "
+            "treating step %d as carrying no stream position", path,
+            step, exc_info=True)
+        return None
+
+
+def write_watermark(directory: str, step: int, payload: dict) -> str:
+    """Atomically-renamed watermark write (same contract as
+    write_manifest): the sidecar either exists complete or not at all —
+    a torn watermark must never resume a stream at a garbage offset."""
+    path = watermark_path(directory, step)
+    _atomic_write_text(path, json.dumps(payload, sort_keys=True))
+    return path
+
+
+def read_published(directory: str) -> Optional[int]:
+    """The step the ``published`` pointer names, or None (never
+    published / unreadable / garbled)."""
+    try:
+        # fmlint: disable=R010 -- a scorer-side poll: absent is the
+        # normal pre-first-publish state and any flake reads as "not
+        # published yet" on this attempt, which the next poll heals
+        with open(os.path.join(directory, PUBLISHED_POINTER),
+                  encoding="utf-8") as fh:
             return int(fh.read().strip())
     except (OSError, ValueError):
         return None
@@ -154,27 +241,11 @@ def compute_manifest(directory: str, step: int,
 
 def write_manifest(directory: str, step: int,
                    manifest: Dict[str, Any]) -> str:
-    """Atomically-renamed manifest write (tmp + fsync + replace): a
+    """Atomically-renamed manifest write (_atomic_write_text): a
     manifest either exists complete or not at all — a torn manifest
     must never brand an intact step corrupt."""
     path = manifest_path(directory, step)
-    tmp = path + ".tmp"
-    try:
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(manifest, fh, sort_keys=True)
-            fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, path)
-    except BaseException:
-        # A failed write must not litter: the .tmp is worthless (the
-        # rename never happened) and would otherwise accumulate across
-        # restarts. A hard kill still can leave one — the orphan scans
-        # (SIDECAR_RE) sweep those.
-        try:
-            os.remove(tmp)
-        except OSError:
-            pass
-        raise
+    _atomic_write_text(path, json.dumps(manifest, sort_keys=True))
     return path
 
 
@@ -268,6 +339,7 @@ class CheckpointState:
         if verify not in CKPT_VERIFY_MODES:
             raise ValueError(f"unknown ckpt_verify mode {verify!r} "
                              f"(want one of {CKPT_VERIFY_MODES})")
+        self._max_to_keep = int(max_to_keep)
         self.directory = os.path.abspath(model_file) + ".ckpt"
         self._retry = retry or RetryPolicy(retries=0)
         self.verify = verify
@@ -290,7 +362,8 @@ class CheckpointState:
     def save(self, step: int, table: jax.Array, acc: jax.Array,
              vocabulary_size: int, force: bool = False,
              wait: bool = False, epoch: int = 0,
-             rewrite_stale_metadata: bool = False) -> None:
+             rewrite_stale_metadata: bool = False,
+             stream_state: Optional[dict] = None) -> None:
         """``vocabulary_size`` is stored alongside the arrays: the
         4096-aligned row layout means a changed vocab inside the same
         bucket would otherwise restore shape-compatibly but silently
@@ -377,17 +450,17 @@ class CheckpointState:
                 # quo ante — the run retrains one epoch) or the new one;
                 # the step's arrays are never at risk (advisor finding r4).
                 if rewrite_stale_metadata and jax.process_index() == 0:
-                    sc = self._epoch_sidecar(step)
-                    tmp = sc + ".tmp"
-                    # fmlint: disable=R010 -- save-side writes are
-                    # deliberately never retried (class docstring): a
-                    # failed sidecar write must fail the final save
-                    # loudly, not mask a torn correction behind backoff
-                    with open(tmp, "w") as fh:
-                        fh.write(str(int(epoch)))
-                        fh.flush()
-                        os.fsync(fh.fileno())
-                    os.replace(tmp, sc)
+                    _atomic_write_text(self._epoch_sidecar(step),
+                                       str(int(epoch)))
+            # Stream-mode durable position (run_mode = stream): the
+            # watermark sidecar pairs with the step exactly like the
+            # epoch sidecar — written AFTER the fresh-step prune above
+            # (which clears any stale same-step watermark), on BOTH the
+            # fresh-save and same-step-collision paths (the collision's
+            # array state is identical, and so is the watermark: it
+            # only advances with global steps).
+            if stream_state is not None and jax.process_index() == 0:
+                write_watermark(self.directory, int(step), stream_state)
             if wait:
                 self._mngr.wait_until_finished()
                 self._flush_pending_manifest()
@@ -457,8 +530,14 @@ class CheckpointState:
         listdir/all_steps may fail an already-committed save."""
         if fresh_step is not None:
             mp = manifest_path(self.directory, fresh_step)
+            wp = watermark_path(self.directory, fresh_step)
+            # The watermark is correctness-bearing like the epoch
+            # sidecar: a surviving stale one (cleared-and-reused dir,
+            # or an epoch-mode save landing on an old stream step)
+            # would resume a later stream at positions THIS state
+            # never trained.
             for stale in (self._epoch_sidecar(fresh_step), mp,
-                          mp + ".tmp"):
+                          mp + ".tmp", wp, wp + ".tmp"):
                 try:
                     os.remove(stale)
                 except FileNotFoundError:
@@ -504,6 +583,88 @@ class CheckpointState:
             restored["epoch"] = np.int64(override)
         return restored
 
+    def _attach_stream(self, step: int, restored):
+        """Overlay the step's stream-watermark sidecar (run_mode =
+        stream) onto a restored tree as ``restored["stream"]`` (None
+        when absent — every epoch-mode checkpoint). Multi-process:
+        process 0 reads, the JSON is broadcast (two fixed-shape
+        collectives), so a transient read error on one host can never
+        resume workers at different stream positions."""
+        if restored is None:
+            return restored
+        wm = None
+        if jax.process_index() == 0:
+            wm = read_watermark(self.directory, step)
+        # identity when single-process; the agreed (chief) value else
+        wm = self._broadcast_json(wm, "checkpoint/watermark")
+        restored["stream"] = wm
+        return restored
+
+    def _broadcast_json(self, obj, label: str):
+        """Process 0's JSON-serializable value on every process: the
+        variable-size companion of ``_broadcast_int``. ONE
+        implementation — data/stream.broadcast_blob (the length-then-
+        padded-payload chief broadcast, with its transport dtype
+        handling) — so the protocol can't fork between the stream
+        discovery and the restore-side watermark attach. stream.py
+        imports nothing from this module, so no cycle."""
+        from fast_tffm_tpu.data.stream import broadcast_blob
+        return broadcast_blob(obj, label)
+
+    # -- stream-mode publishing ------------------------------------------
+
+    def publish_step(self, step: int) -> Optional[str]:
+        """Atomically repoint the ``published`` pointer file at a
+        manifest-VERIFIED committed step — the hot-reload signal a
+        serving process watches (``fmckpt ls`` shows it). The caller
+        must have settled the step's save + manifest first (a
+        ``wait=True`` save does). Verification runs at the instance's
+        ``ckpt_verify`` mode (minimum ``size`` — a publish is a promise
+        to a scorer, so ``off`` still size-checks); on failure the
+        pointer is NOT moved (the previous published step stays live),
+        a warning names the reason, and None returns. Process 0 only;
+        multi-host callers gate on it like the manifest writer."""
+        if jax.process_index() != 0:
+            return None
+        mode = self.verify if self.verify != "off" else "size"
+        reason = verify_step_dir(self.directory, step, mode)
+        if reason is not None:
+            get_logger().warning(
+                "publish of checkpoint step %d skipped: %s — the "
+                "previous published pointer stays in place", step,
+                reason)
+            tel = _tel()
+            if tel is not None:
+                tel.count("stream/publish_failures")
+            return None
+        path = os.path.join(self.directory, PUBLISHED_POINTER)
+        _atomic_write_text(path, f"{int(step)}\n")
+        tel = _tel()
+        if tel is not None:
+            tel.count("stream/publishes")
+        get_logger().info(
+            "published checkpoint step %d (%s-verified) -> %s", step,
+            mode, path)
+        return path
+
+    def published_at_risk(self) -> bool:
+        """Whether retention is about to lap the ``published`` pointer:
+        True when the pointed-at step is gone already, or one more
+        periodic save would GC it (max_to_keep newest-N eviction). The
+        stream driver republishes FIRST when this fires, so the
+        pointer a scorer resolves never names a deleted step — frequent
+        ``save_steps`` saves under a long ``publish_interval_seconds``
+        would otherwise delete the published checkpoint out from under
+        the serving fleet mid-interval."""
+        pub = read_published(self.directory)
+        if pub is None:
+            return False
+        steps = list_step_dirs(self.directory)
+        if pub not in steps:
+            return True  # already dangling: republish immediately
+        newer = sum(1 for s in steps if s > pub)
+        return newer >= self._max_to_keep - 1
+
     # -- integrity: verify / quarantine / step decision -----------------
 
     def verify_step(self, step: int,
@@ -531,7 +692,8 @@ class CheckpointState:
             dst = os.path.join(self.directory,
                                f"{QUARANTINE_PREFIX}{step}.{k}")
         os.rename(src, dst)
-        for name in (f"manifest-{step}.json", f"epoch_override-{step}"):
+        for name in (f"manifest-{step}.json", f"epoch_override-{step}",
+                     f"watermark-{step}.json"):
             try:
                 os.replace(os.path.join(self.directory, name),
                            os.path.join(dst, name))
@@ -679,7 +841,8 @@ class CheckpointState:
                 restored, err = self._attempt_restore(step, template)
                 if err is not None:
                     self._raise_restore_error(step, err)
-                return self._apply_epoch_override(step, restored)
+                return self._attach_stream(
+                    step, self._apply_epoch_override(step, restored))
             return self._restore_newest_intact(template)
 
     def _restore_newest_intact(self, template
@@ -730,7 +893,8 @@ class CheckpointState:
                     if tel is not None:  # process 0 only: quarantined
                         # is always 0 elsewhere, so the count is global
                         tel.count("checkpoint/fallbacks")
-                return self._apply_epoch_override(cand, restored)
+                return self._attach_stream(
+                    cand, self._apply_epoch_override(cand, restored))
             if err is None:
                 # This process succeeded but a peer didn't: walk back
                 # with everyone (the restored tree may hold

@@ -182,6 +182,34 @@ class FmConfig:
     # corrupt-<step>, never deleted) and restore falls back to the
     # newest older intact step. Inspect with: python -m tools.fmckpt
     ckpt_verify: str = "size"       # "off" | "size" | "full"
+    # Streaming / online learning (README "Streaming / online
+    # learning"; data/stream.py + train.py). run_mode = epochs keeps
+    # the historical fixed-schedule behavior; run_mode = stream follows
+    # ``stream_dir`` (a directory, or a glob pattern) for arriving
+    # libsvm shards and trains ONE continuous arrival-ordered pass
+    # that survives indefinitely: new files are picked up every
+    # ``stream_poll_seconds``, growing files are tailed with the torn
+    # trailing line held back until more bytes arrive or the file is
+    # sealed, and the durable stream position (per-file byte/line
+    # watermark) rides every checkpoint so a restart resumes with no
+    # example duplicated or skipped. ``epoch_num``/``shuffle`` have no
+    # effect in stream mode (an online pass is arrival-ordered by
+    # design); a ``STOP`` marker file in the stream directory ends the
+    # run once every sealed byte is consumed.
+    run_mode: str = "epochs"        # "epochs" | "stream"
+    stream_dir: str = ""            # directory or glob of arriving shards
+    stream_poll_seconds: float = 2.0
+    # When an arriving file counts as SEALED (complete, safe to consume
+    # through EOF): "done" requires a ``<file>.done`` marker; "quiet"
+    # seals after the file's mtime has been quiet for
+    # 3 x stream_poll_seconds; "auto" (default) accepts either signal.
+    seal_policy: str = "auto"       # "auto" | "done" | "quiet"
+    # Stream-mode checkpoint publishing: every this many seconds, save,
+    # settle the integrity manifest, verify the step, and atomically
+    # repoint the ``published`` pointer file in <model_file>.ckpt/ that
+    # a serving process can watch (fmckpt ls shows it). 0 = no
+    # publishing (periodic save_steps saves still apply).
+    publish_interval_seconds: float = 0.0
 
     # --- [Predict] ---------------------------------------------------------
     predict_files: Tuple[str, ...] = ()
@@ -334,6 +362,43 @@ class FmConfig:
             raise ValueError(
                 f"unknown ckpt_verify {self.ckpt_verify!r} "
                 "(want off | size | full)")
+        if self.run_mode not in ("epochs", "stream"):
+            raise ValueError(
+                f"unknown run_mode {self.run_mode!r} "
+                "(want epochs | stream)")
+        if self.seal_policy not in ("auto", "done", "quiet"):
+            raise ValueError(
+                f"unknown seal_policy {self.seal_policy!r} "
+                "(want auto | done | quiet)")
+        if self.stream_poll_seconds <= 0:
+            raise ValueError(
+                f"stream_poll_seconds must be > 0, got "
+                f"{self.stream_poll_seconds}")
+        if self.publish_interval_seconds < 0:
+            raise ValueError(
+                f"publish_interval_seconds must be >= 0 (0 = no "
+                f"publishing), got {self.publish_interval_seconds}")
+        if self.run_mode == "stream":
+            if not self.stream_dir:
+                raise ValueError(
+                    "run_mode = stream requires stream_dir (a "
+                    "directory or glob of arriving libsvm shards)")
+            if self.train_files:
+                raise ValueError(
+                    "train_files is set but run_mode = stream consumes "
+                    "stream_dir; drop train_files (or run_mode) — a "
+                    "silently untrained corpus is always a config "
+                    "mistake")
+            if self.weight_files:
+                raise ValueError(
+                    "run_mode = stream does not support weight_files: "
+                    "weight sidecars pair lines to a FIXED corpus, "
+                    "which an append-only stream is not")
+        elif self.stream_dir:
+            raise ValueError(
+                "stream_dir is set but run_mode is 'epochs'; set "
+                "run_mode = stream (or drop stream_dir) — a silently "
+                "ignored stream directory is always a config mistake")
         if self.cluster_connect_timeout_seconds <= 0:
             raise ValueError(
                 f"cluster_connect_timeout_seconds must be > 0, got "
@@ -457,6 +522,11 @@ _TRAIN_KEYS = {
     "io_retries": int,
     "io_backoff_seconds": float,
     "ckpt_verify": str,
+    "run_mode": str,
+    "stream_dir": str,
+    "stream_poll_seconds": float,
+    "seal_policy": str,
+    "publish_interval_seconds": float,
 }
 _PREDICT_KEYS = {
     "predict_files": _split_files,

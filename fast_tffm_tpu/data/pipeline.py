@@ -142,6 +142,13 @@ class DeviceBatch:
     vals: np.ndarray         # f32 [B, L]; 0.0 padding
     fields: Optional[np.ndarray] = None  # i32 [B, L]; 0 padding (FFM)
     num_real: int = 0        # examples that are not padding
+    # Streaming run mode only (data/stream.py): the durable stream
+    # position AFTER this batch's lines — a watermark payload dict the
+    # train loop adopts once the batch has actually been stepped, so
+    # checkpoints record exactly what was trained (prefetched-but-
+    # unstepped batches must not advance the stream position). None
+    # everywhere outside stream mode.
+    stream_pos: Optional[dict] = None
 
     @property
     def shape_key(self) -> Tuple[int, int, int, bool]:

@@ -14,7 +14,7 @@ and tests; no jax import.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from fast_tffm_tpu.obs.registry import Histogram, MetricsRegistry
 from fast_tffm_tpu.obs.sink import read_events
@@ -188,6 +188,21 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
         "workers_lost": c.get("cluster/workers_lost", 0),
         "elastic_recoveries": c.get("cluster/elastic_recoveries", 0),
         "bringup_failures": c.get("cluster/bringup_failures", 0),
+        # Streaming run mode (README "Streaming / online learning"):
+        # discovery/seal/damage counters plus the freshness gauges the
+        # STALE PUBLISH health verdict reads.
+        "stream_files_discovered": c.get("stream/files_discovered", 0),
+        "stream_files_sealed": c.get("stream/files_sealed", 0),
+        "stream_truncated_files": c.get("stream/truncated_files", 0),
+        "stream_deleted_files": c.get("stream/deleted_files", 0),
+        "stream_publishes": c.get("stream/publishes", 0),
+        "stream_publish_failures": c.get("stream/publish_failures", 0),
+        "stream_watermark_lag_seconds": g.get(
+            "stream/watermark_lag_seconds"),
+        "stream_last_publish_age_seconds": g.get(
+            "stream/last_publish_age_seconds"),
+        "stream_publish_interval_seconds": g.get(
+            "stream/publish_interval_seconds"),
     }
 
     # Predict-path stats (a predict stream has no train loop at all;
@@ -369,6 +384,20 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                     [f"{len(stalls)} stall episode(s), worst "
                      f"{worst:.1f}s without progress{rec}; stacks: "
                      f"{stalls[0].get('stacks_file', '?')}"] + notes)}
+    stale = stale_publish(summary)
+    if stale is not None:
+        # Checked BEFORE the unclosed-stream heuristic: a live stream
+        # run legitimately has no run_end yet, and "the scorer is
+        # being starved of fresh checkpoints" is the actionable
+        # diagnosis there — a crashed stream run with no crash event
+        # still reads STALE PUBLISH + the no-run_end note.
+        age, interval = stale
+        return {"verdict": "STALE PUBLISH",
+                "detail": "; ".join(
+                    [f"last published checkpoint is {age:.0f}s old, "
+                     f"over 3x the {interval:.0f}s publish interval — "
+                     "scorers are reloading stale state; check the "
+                     "stream run's save/verify path"] + notes)}
     if unclosed:
         return {"verdict": "CRASHED", "detail": notes[0]}
     if fallbacks:
@@ -384,6 +413,27 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                      "space with `python -m tools.fmckpt gc`"] + notes)}
     return {"verdict": "OK", "detail": "no health/crash events; "
             "run_end present"}
+
+
+def stale_publish(summary: Dict[str, Any]
+                  ) -> Optional[Tuple[float, float]]:
+    """(publish age, configured interval) when the stream run's last
+    publish is older than STALE_PUBLISH_MULTIPLE x the interval at the
+    final metrics flush, else None. Only meaningful for streams that
+    publish (interval gauge present and > 0)."""
+    g = summary.get("gauges", {})
+    interval = g.get("stream/publish_interval_seconds")
+    age = g.get("stream/last_publish_age_seconds")
+    if not interval or age is None:
+        return None
+    if age > STALE_PUBLISH_MULTIPLE * interval:
+        return float(age), float(interval)
+    return None
+
+
+# Publish-freshness ceiling, in intervals: past this the health verdict
+# flips to STALE PUBLISH (the serving fleet is reloading old state).
+STALE_PUBLISH_MULTIPLE = 3.0
 
 
 def dedup_hit_rate(counters: Dict[str, float]) -> Optional[float]:
@@ -505,6 +555,27 @@ def render(summary: Dict[str, Any]) -> str:
         ]
     for k, v in rows:
         lines.append(f"  {k:<34} {_fmt(v)}")
+    if att["stream_files_discovered"] or att[
+            "stream_publish_interval_seconds"]:
+        lines.append("  STREAMING (run_mode = stream):")
+        age = att["stream_last_publish_age_seconds"]
+        interval = att["stream_publish_interval_seconds"]
+        for k, v in (
+                ("watermark lag (s)",
+                 att["stream_watermark_lag_seconds"]),
+                ("files discovered / sealed",
+                 f"{_fmt(att['stream_files_discovered'])} / "
+                 f"{_fmt(att['stream_files_sealed'])}"),
+                ("files truncated / deleted",
+                 f"{_fmt(att['stream_truncated_files'])} / "
+                 f"{_fmt(att['stream_deleted_files'])}"),
+                ("publishes (failed)",
+                 f"{_fmt(att['stream_publishes'])} "
+                 f"({_fmt(att['stream_publish_failures'])})"),
+                ("last publish age / interval (s)",
+                 f"{_fmt(age)} / {_fmt(interval)}"),
+        ):
+            lines.append(f"    {k:<32} {v}")
     worker_rows = worker_table(summary)
     if worker_rows:
         lines.append("  workers (per-process liveness):")

@@ -373,6 +373,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
     driver can safely re-enter after a recovery."""
     spec = ModelSpec.from_config(cfg)
     multi_process = jax.process_count() > 1
+    stream_mode = getattr(cfg, "run_mode", "epochs") == "stream"
     offload = cfg.lookup == "host"
     if offload and multi_process:
         # Design position, not a gap: any multi-host v5e job has >= 8
@@ -434,18 +435,20 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
         # tolerant fixed-shape all route serial).
         host_workers = host_parallel_workers(
             cfg, cfg.weight_files, fixed_shape=multi_process)
-        if host_workers > 1:
+        if host_workers > 1 and not stream_mode:
             logger.info(
                 "host data plane: %d parallel batch-build workers "
                 "(host_threads = %s; bounded ordered ring)",
                 host_workers, cfg.host_threads)
         uniq_bucket = 0
-        if multi_process:
+        if multi_process and not stream_mode:
             # Fixed-shape batches need one U for the whole job. Auto mode
             # measures the data (probe is deterministic and identical on
             # every process) instead of assuming the next_pow2(B*L) worst
             # case — a ~50x smaller gather/scatter per step at Criteo-like
             # density; denser-than-probed batches spill, never break.
+            # (Stream mode probes the discovered SEALED shards instead,
+            # chief-decided — data/stream.probe_stream_uniq_bucket.)
             from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
             uniq_bucket = cfg.uniq_bucket or probe_uniq_bucket(
                 cfg, cfg.train_files)
@@ -665,7 +668,308 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
         # SIGTERM/SIGINT swallowed into a dead flag list.
         completed_epochs = start_epoch
         last_periodic_save = (None, None)  # (step, epoch) of the latest
-        for epoch in range(start_epoch, cfg.epoch_num):
+        # Streaming run mode (README "Streaming / online learning"):
+        # the durable stream position adopted from STEPPED batches —
+        # what every checkpoint records beside the arrays, so restore
+        # resumes with no example duplicated or skipped. None in epoch
+        # mode (saves then carry no watermark sidecar).
+        stream_watermark = None
+
+        def _stream_state_for_save():
+            """The watermark payload a save should carry right now:
+            merged across workers at this lockstep point (a collective
+            when multi-process — callers must invoke it at
+            step-deterministic points only)."""
+            if not stream_mode:
+                return None
+            from fast_tffm_tpu.data.stream import exchange_watermarks
+            wm = stream_watermark or {"format": 1, "files": []}
+            return (exchange_watermarks(wm, num_shards)
+                    if multi_process else wm)
+
+        def _run_stream():
+            """The indefinitely-surviving online loop: poll the stream
+            source, step every arriving batch, save with the watermark,
+            and publish a manifest-verified checkpoint every
+            ``publish_interval_seconds``. Single-process overlaps build
+            and compute through the prefetch thread; multi-worker runs
+            the source inline on this thread so its one discovery
+            collective per iteration stays aligned with the lockstep
+            flags allgather and the step program (collectives from two
+            threads would interleave nondeterministically across
+            workers — the deadlock class the window protocol exists to
+            prevent)."""
+            nonlocal global_step, loss, stopping, stream_watermark, \
+                last_periodic_save, table, acc
+            from fast_tffm_tpu.data import stream as streamlib
+            from fast_tffm_tpu.data.pipeline import empty_batch
+            restored_wm = (restored or {}).get("stream")
+            if restored is not None and restored_wm is None:
+                logger.warning(
+                    "restored checkpoint at step %d carries no stream "
+                    "watermark (an epoch-mode warm start, or a lost "
+                    "watermark sidecar): streaming starts from the "
+                    "BEGINNING of %s — any stream bytes this model "
+                    "already trained on will be trained again",
+                    global_step, cfg.stream_dir)
+            tracker = streamlib.StreamTracker(
+                cfg.stream_dir, cfg.stream_poll_seconds,
+                cfg.seal_policy, retry=RetryPolicy.from_config(cfg),
+                shard_index=shard_index, num_shards=num_shards,
+                bad_lines=bad_tracker, watermark=restored_wm,
+                lockstep=multi_process)
+            u_bucket = 0
+            if multi_process:
+                u_bucket = (cfg.uniq_bucket
+                            or streamlib.probe_stream_uniq_bucket(
+                                cfg, tracker))
+                logger.info("fixed unique-row bucket: %d", u_bucket)
+            workers = streamlib.stream_workers(
+                cfg, fixed_shape=multi_process)
+            if workers > 1:
+                logger.info(
+                    "stream host data plane: %d parallel batch-build "
+                    "workers (host_threads = %s; sealed line groups "
+                    "through the bounded ordered ring)",
+                    workers, cfg.host_threads)
+            source = streamlib.StreamSource(
+                cfg, tracker,
+                stop=(None if multi_process
+                      else (lambda: bool(preempted))),
+                fixed_shape=multi_process, uniq_bucket=u_bucket,
+                raw_ids=raw_mode, workers=workers,
+                bad_lines=bad_tracker)
+            publish_every = float(
+                getattr(cfg, "publish_interval_seconds", 0.0))
+            last_publish = [time.monotonic()]
+            if tel is not None:
+                tel.set("stream/publish_interval_seconds",
+                        publish_every)
+
+            def publish_due() -> bool:
+                """Interval elapsed, OR retention pressure: periodic
+                save_steps saves must never GC the published step out
+                from under a scorer mid-interval — republishing first
+                repoints at fresh state instead of letting the pointer
+                dangle. Chief-only in lockstep mode (the decision
+                rides the flags allgather)."""
+                if publish_every <= 0:
+                    return False
+                if time.monotonic() - last_publish[0] >= publish_every:
+                    return True
+                return bool(cfg.save_steps) and ckpt.published_at_risk()
+
+            def stream_gauges():
+                if tel is None:
+                    return
+                tel.set("stream/watermark_lag_seconds",
+                        tracker.watermark_lag_seconds())
+                if publish_every > 0:
+                    tel.set("stream/last_publish_age_seconds",
+                            time.monotonic() - last_publish[0])
+
+            def stream_save(wait: bool, force: bool = False) -> None:
+                nonlocal last_periodic_save
+                state = (lk.state() if offload
+                         else ckpt_state(cfg, table, acc))
+                ckpt.save(global_step, *state,
+                          vocabulary_size=cfg.vocabulary_size,
+                          force=force, wait=wait, epoch=0,
+                          stream_state=_stream_state_for_save())
+                last_periodic_save = (global_step, 0)
+                if tel is not None:
+                    tel.count("train/checkpoints")
+
+            def do_publish() -> None:
+                """save + settle the manifest + verify + atomically
+                repoint the ``published`` pointer. Lockstep-safe: every
+                worker runs the save's commit barrier; only process 0
+                flips the pointer."""
+                with span("checkpoint/publish", step=global_step):
+                    # fmlint: disable=R003 -- feeds the train/
+                    # checkpoint_pause_seconds counter (the publish
+                    # span is the timeline view)
+                    t_pub = time.perf_counter()
+                    stream_save(wait=True)
+                    ckpt.publish_step(global_step)
+                    if tel is not None:
+                        # fmlint: disable=R003 -- closes the sample
+                        tel.count("train/checkpoint_pause_seconds",
+                                  time.perf_counter() - t_pub)
+                last_publish[0] = time.monotonic()
+                stream_gauges()
+
+            # fmlint: disable=R003 -- anchors the stream step-seconds
+            # window (always-on aggregate)
+            t_prev = [time.perf_counter()]
+
+            def step_once(batch) -> None:
+                nonlocal global_step, loss, stream_watermark
+                nonlocal table, acc
+                args = batch_args(batch)
+                h2d_bytes = (batch_payload_bytes(args)
+                             if tel is not None else 0)
+                if multi_process:
+                    with span("train/h2d", bytes=h2d_bytes):
+                        args = global_batch(mesh, len(batch.uniq_ids),
+                                            **args)
+                elif mesh is not None:
+                    with span("train/h2d", bytes=h2d_bytes):
+                        args = shard_batch(mesh, **args)
+                with span("train/step", step=global_step + 1):
+                    if multi_process:
+                        from fast_tffm_tpu.parallel.liveness import (
+                            guarded_collective)
+                        table, acc, loss, _ = guarded_collective(
+                            step_fn, table, acc,
+                            label="train/step_dispatch", **args)
+                    else:
+                        table, acc, loss, _ = step_fn(table, acc,
+                                                      **args)
+                global_step += 1
+                if batch.stream_pos is not None:
+                    # The durable position advances ONLY with stepped
+                    # batches (lockstep fillers carry None).
+                    stream_watermark = batch.stream_pos
+                n_global = batch.num_real * (jax.process_count()
+                                             if multi_process else 1)
+                timer.tick(n_global)
+                if tel is not None:
+                    # fmlint: disable=R003 -- feeds the train/
+                    # step_seconds histogram (always-on aggregate)
+                    now = time.perf_counter()
+                    tel.train_step(now - t_prev[0], n_global,
+                                   h2d_bytes)
+                    t_prev[0] = now
+                    tel.heartbeat(global_step)
+                profile_tick(global_step)
+                log_due = (cfg.log_steps
+                           and global_step % cfg.log_steps == 0)
+                tel_due = (tel is not None
+                           and tel.flush_due(global_step))
+                eps_now = (timer.consume_window_rate()
+                           if (log_due or tel_due) else None)
+                if log_due:
+                    log_tick(global_step, 0, loss, eps_now)
+                if tel_due:
+                    tel.add_scalar("train/loss", global_step, loss)
+                    tel.set("train/examples_per_sec_window", eps_now)
+                    tel.set("train/examples_per_sec_total",
+                            timer.total_examples_per_sec)
+                    stream_gauges()
+                    tel.maybe_flush(global_step)
+                if cfg.save_steps and global_step % cfg.save_steps == 0:
+                    # fmlint: disable=R003 -- feeds the train/
+                    # checkpoint_pause_seconds counter
+                    t_ck = time.perf_counter()
+                    stream_save(wait=offload)
+                    if tel is not None:
+                        # fmlint: disable=R003 -- closes the sample
+                        dt_ck = time.perf_counter() - t_ck
+                        tel.count("train/checkpoint_pause_seconds",
+                                  dt_ck)
+                        t_prev[0] += dt_ck
+
+            def emit_preempted() -> None:
+                nonlocal stopping
+                stopping = True
+                logger.info("preemption signalled; saving the stream "
+                            "position and exiting")
+                if tel is not None:
+                    tel.sink.emit("health", {
+                        "status": "preempted", "step": global_step,
+                        "epoch": 0})
+
+            try:
+                if multi_process:
+                    from jax.experimental import multihost_utils
+                    from fast_tffm_tpu.parallel.liveness import (
+                        guarded_collective)
+                    while True:
+                        b = source.next_batch(block=False)
+                        has = b not in (streamlib.IDLE, streamlib.DONE)
+                        done = b is streamlib.DONE
+                        pub_due = publish_due()
+                        flags = np.asarray(guarded_collective(
+                            multihost_utils.process_allgather,
+                            np.asarray([has, bool(preempted), done,
+                                        pub_due]),
+                            label="stream/step_flags")).reshape(-1, 4)
+                        if bool(flags[:, 1].any()):
+                            emit_preempted()
+                            break
+                        if bool(flags[:, 2].all()) and not bool(
+                                flags[:, 0].any()):
+                            break
+                        if bool(flags[:, 0].any()):
+                            batch = (b if has else empty_batch(
+                                cfg, uniq_bucket=u_bucket))
+                            step_once(batch)
+                        else:
+                            if tel is not None:
+                                tel.heartbeat()
+                            stream_gauges()
+                            time.sleep(min(cfg.stream_poll_seconds,
+                                           0.5))
+                        if bool(flags[0, 3]):  # the CHIEF's clock
+                            do_publish()
+                else:
+                    # StreamPrefetcher, not pipeline.prefetch: the
+                    # driver must keep its publish clock and
+                    # preemption checks ticking while the stream
+                    # idles — a blocking queue get would starve
+                    # publishing for as long as no batch arrives.
+                    pf = streamlib.StreamPrefetcher(
+                        source, depth=cfg.prefetch_depth)
+                    try:
+                        while True:
+                            if preempted:
+                                emit_preempted()
+                                break
+                            batch = pf.get(timeout=min(
+                                cfg.stream_poll_seconds, 0.5))
+                            # fmlint: disable=R007 -- single-process
+                            # arm (the lockstep arm above is the
+                            # multi-worker path): step_once's
+                            # collectives are themselves gated on
+                            # multi_process, so no peer exists to
+                            # diverge from; `batch` reads as
+                            # rank-tainted only through the tracker's
+                            # shard_index plumbing
+                            if batch is streamlib.DONE:
+                                if preempted:
+                                    emit_preempted()
+                                break
+                            # fmlint: disable=R007 -- same
+                            # single-process-arm justification as above
+                            if batch is streamlib.IDLE:
+                                if tel is not None:
+                                    tel.heartbeat()
+                                stream_gauges()
+                            else:
+                                step_once(batch)
+                            if publish_due():
+                                do_publish()
+                    finally:
+                        pf.close()
+            finally:
+                source.close()
+            stream_gauges()  # the exit metrics snapshot carries the
+            # freshness gauges even when the run never hit a flush step
+            flush_log()
+            if bad_tracker is not None and bad_tracker.bad:
+                logger.info("bad-line policy through the stream run: "
+                            "%s", bad_tracker.describe())
+            if source.stats.batches:
+                logger.info("stream input: %s",
+                            source.stats.describe())
+
+        if stream_mode:
+            _run_stream()
+            epoch_schedule = range(0)  # the epoch loop never runs
+        else:
+            epoch_schedule = range(start_epoch, cfg.epoch_num)
+        for epoch in epoch_schedule:
             if stopping:
                 break
             epoch_stats = SpillStats()
@@ -985,7 +1289,34 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
         ckpt.save(global_step, *state,
                   vocabulary_size=cfg.vocabulary_size, force=True,
                   wait=True, epoch=completed_epochs,
-                  rewrite_stale_metadata=stale)
+                  rewrite_stale_metadata=stale,
+                  stream_state=_stream_state_for_save())
+        if stream_mode and getattr(cfg, "publish_interval_seconds",
+                                   0.0) > 0:
+            # The exit publish: a clean STOP drain (or a preemption's
+            # durable save) is the freshest verified state a scorer
+            # can hot-reload; the save above already settled the
+            # manifest (wait=True).
+            ckpt.publish_step(global_step)
+        if stream_mode and not multi_process and cfg.validation_files:
+            # Stream mode has no per-epoch sweeps; a configured
+            # validation corpus gets one final scored pass here
+            # (multi-process streams validate in _chief_finalize below)
+            # — silently accepting-and-ignoring the knob would be a
+            # config trap.
+            auc, n = evaluate(
+                cfg, table, cfg.validation_files, mesh=mesh,
+                backend=lk, max_batches=cfg.validation_max_batches
+                or None, weight_files=cfg.validation_weight_files,
+                bad_lines=bad_tracker)
+            logger.info("final validation AUC %.6f over %d examples",
+                        auc, n)
+            if tel is not None:
+                tel.set("validation/auc", auc)
+                # fmlint: disable=R001 -- auc is already a host float
+                # from the streamed AUC merge
+                tel.add_scalar("validation/auc", global_step,
+                               float(auc))
         if multi_process:
             _chief_finalize(cfg, table, logger, mesh, shard_index,
                             num_shards, last_val, val_bucket,

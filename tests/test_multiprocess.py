@@ -6,10 +6,13 @@ loopback coordinator, and require both to finish with a shared
 checkpoint on disk.
 """
 
+import functools
 import os
+import signal
 import socket
 import subprocess
 import sys
+import warnings
 
 import numpy as np
 import pytest
@@ -21,6 +24,50 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+class _WorkerSignalDeath(Exception):
+    """A spawned worker died on a SIGNAL (SIGSEGV/SIGABRT/SIGBUS) —
+    the signature of the KNOWN pre-existing jaxlib restore-then-step
+    heap corruption (intermittent, upstream, measured at seed), as
+    opposed to a genuine assertion/regression (nonzero exit code,
+    which never retries)."""
+
+    def __init__(self, worker: int, sig: int, out: str):
+        super().__init__(
+            f"worker {worker} died on signal {sig}:\n{out[-2000:]}")
+        self.sig = sig
+
+
+_RERUN_SIGNALS = (signal.SIGSEGV, signal.SIGABRT, signal.SIGBUS)
+
+
+def _rerun_on_worker_signal(times: int = 2):
+    """Bounded rerun guard for the two tests that hit the known jaxlib
+    restore-then-step SIGSEGV (PR 5 session note: intermittent on
+    test_four_worker_cluster_lifecycle and the 2-proc resume shape at
+    seed AND after — upstream heap corruption, not this repo's code).
+    ONLY a signal death reruns (each attempt in a fresh subdirectory,
+    so leftover checkpoints can't contaminate the retry); assertion
+    failures and nonzero worker exits propagate on the first attempt —
+    a real regression must never hide behind the retry."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(tmp_path):
+            for attempt in range(times + 1):
+                sub = tmp_path / f"attempt{attempt}"
+                sub.mkdir()
+                try:
+                    return fn(sub)
+                except _WorkerSignalDeath as e:
+                    if attempt >= times:
+                        raise
+                    warnings.warn(
+                        f"{fn.__name__}: worker died on signal "
+                        f"{e.sig} (known jaxlib flake); rerun "
+                        f"{attempt + 1}/{times}")
+        return wrapper
+    return deco
 
 
 def _write_cfg(cfg_path, data, model, epoch_num):
@@ -52,6 +99,7 @@ def _launch(cfg_path):
 
 
 @pytest.mark.slow
+@_rerun_on_worker_signal(times=2)
 def test_two_worker_dist_train_and_resume(tmp_path):
     rng = np.random.default_rng(0)
     # 193 lines over 2 workers with batch_size 32: shards of 97/96 lines
@@ -165,11 +213,18 @@ def _launch_mode(cfg_path, mode, n_procs: int = 2,
         out, _ = p.communicate(timeout=300)
         outs.append(out)
     for i, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode is not None and -p.returncode in [
+                int(s) for s in _RERUN_SIGNALS]:
+            # Signal death: the known upstream jaxlib flake class —
+            # raised as its own type so _rerun_on_worker_signal can
+            # retry it (bounded) without masking real failures.
+            raise _WorkerSignalDeath(i, -p.returncode, out)
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
     return outs
 
 
 @pytest.mark.slow
+@_rerun_on_worker_signal(times=2)
 def test_four_worker_cluster_lifecycle(tmp_path):
     """The full job lifecycle at P=4 with REAL transport (round-4
     review: every protocol beyond P=2 ran only simulated through the
@@ -575,6 +630,90 @@ worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
         assert "raising uniq_bucket 64 -> 128" in out, f"worker {i}"
         assert "raising uniq_bucket 128 -> 256" in out, f"worker {i}"
     assert any("training done" in o for o in outs)
+
+
+@pytest.mark.slow
+def test_two_worker_stream_mode(tmp_path):
+    """run_mode = stream at P=2 with real transport: ledger-index file
+    ownership (files i % 2), the per-iteration discovery broadcast
+    aligned with the lockstep flags allgather, late-arriving shards
+    picked up mid-run, merged watermarks on every save, and a verified
+    publish — the compute-plane leg of the streaming run mode."""
+    import time
+    from tools.fmchaos import _write_corpus
+    sd = tmp_path / "stream"
+    sd.mkdir()
+    n0, per = 4, 160  # 4 shards up front, 2 more arrive mid-run
+    for i in range(n0):
+        _write_corpus(str(sd / f"part-{i:03d}.txt"), per, i)
+        (sd / f"part-{i:03d}.txt.done").touch()
+    model = tmp_path / "model" / "fm"
+    metrics = tmp_path / "m.jsonl"
+    coord = _free_port()
+    cfg = tmp_path / "dist.cfg"
+    cfg.write_text(f"""
+[General]
+vocabulary_size = 200
+factor_num = 4
+model_file = {model}
+
+[Train]
+run_mode = stream
+stream_dir = {sd}
+stream_poll_seconds = 0.1
+seal_policy = done
+publish_interval_seconds = 1.0
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+log_steps = 0
+metrics_file = {metrics}
+metrics_flush_steps = 4
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, "run_tffm.py", "train", str(cfg),
+         "dist_train", "worker", str(i)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    try:
+        time.sleep(12)  # past bring-up; the first shards streaming
+        for i in range(n0, n0 + 2):  # late arrivals, then STOP
+            _write_corpus(str(sd / f"part-{i:03d}.txt"), per, i)
+            (sd / f"part-{i:03d}.txt.done").touch()
+        (sd / "STOP").touch()
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+    assert all("training done" in o for o in outs)
+    assert any("part-005" in o for o in outs)  # late arrival consumed
+    # 6 files x 160 lines / 32 = 5 batches per file, 3 files per
+    # worker: 15 lockstep steps, every shard consumed exactly once.
+    from fast_tffm_tpu.checkpoint import (list_step_dirs,
+                                          read_published,
+                                          read_watermark)
+    ckpt_dir = str(model) + ".ckpt"
+    steps = list_step_dirs(ckpt_dir)
+    assert steps and steps[-1] == 15, steps
+    assert read_published(ckpt_dir) == 15
+    wm = read_watermark(ckpt_dir, 15)
+    assert wm is not None and len(wm["files"]) == 6
+    # The merged watermark has every file fully consumed (the owner's
+    # positions won the merge for each ledger index).
+    for rec in wm["files"]:
+        assert rec["sealed"] and rec["bytes"] == rec["end"], rec
+        assert rec["lines"] == per, rec
 
 
 @pytest.mark.slow

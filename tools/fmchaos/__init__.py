@@ -35,6 +35,18 @@ asserts the documented recovery behavior:
                       cleanly, ``fmstat`` reports PREEMPTED (not
                       CRASHED); a restart resumes the interrupted
                       epoch schedule and finishes OK.
+- ``stream-soak``     run_mode = stream against a LIVE writer
+                      injecting torn writes, plus flaky opens and one
+                      mid-stream SIGTERM+resume → every sealed line is
+                      consumed exactly once (final table BIT-IDENTICAL
+                      to a clean single-pass control run over the same
+                      sealed corpus) and >= 2 ``published`` pointer
+                      flips land on manifest-verified steps.
+- ``stream-truncate`` an in-progress (unsealed) stream file SHRINKS
+                      under the reader → the (inode, size) regression
+                      is quarantined through the BadLineTracker, the
+                      run survives and finishes the successor shard,
+                      breaker accounting exact.
 - ``truncate-latest`` the newest checkpoint step is torn (truncated
                       array file) → with ``ckpt_verify = size`` the
                       restart quarantines it (``corrupt-<step>``,
@@ -476,6 +488,206 @@ log_steps = 0
             f"(verdict {v!r})")
 
 
+# --- streaming run-mode scenarios ----------------------------------------
+
+
+def _corpus_lines(n: int, seed: int) -> list:
+    """The synthetic corpus as a line list (the stream writer appends
+    them progressively instead of writing a file at once)."""
+    import tempfile
+    with tempfile.NamedTemporaryFile("r", suffix=".txt",
+                                     delete=False) as fh:
+        tmp = fh.name
+    try:
+        _write_corpus(tmp, n, seed)
+        with open(tmp) as fh:
+            return fh.read().splitlines()
+    finally:
+        os.remove(tmp)
+
+
+def _append_shard_torn(path: str, lines: list, pause: float) -> None:
+    """Append one shard the hostile way: several flushes, each ending
+    with a TORN half-line that the next write completes — the reader
+    must hold the torn tail back or it trains garbage — then the
+    ``.done`` seal marker."""
+    import time as _time
+    thirds = max(1, len(lines) // 3)
+    pos = 0
+    with open(path, "a") as fh:
+        while pos < len(lines):
+            seg = lines[pos:pos + thirds]
+            pos += len(seg)
+            blob = "\n".join(seg) + "\n"
+            if pos < len(lines):
+                nxt = lines[pos]
+                cut = max(1, len(nxt) // 2)
+                fh.write(blob + nxt[:cut])   # torn write: half a line
+                fh.flush()
+                _time.sleep(pause)
+                fh.write(nxt[cut:] + "\n")   # completed next flush
+                fh.flush()
+                pos += 1
+            else:
+                fh.write(blob)
+                fh.flush()
+            _time.sleep(pause)
+    open(path + ".done", "w").close()
+
+
+def _stream_cfg(workdir: str, stream_dir: str, **overrides):
+    base = dict(run_mode="stream", stream_dir=stream_dir,
+                stream_poll_seconds=0.05, seal_policy="done",
+                shuffle=False, epoch_num=1)
+    base.update(overrides)
+    return _cfg(workdir, "", train_files=(), **base)
+
+
+def scenario_stream_soak(workdir: str, seed: int = 0) -> str:
+    """The streaming acceptance soak: a writer thread appends 6 shards
+    WITH injected torn writes while the trainer streams them; a
+    SIGTERM lands mid-stream and the restart resumes from the
+    checkpointed watermark; the tail of the corpus is consumed under
+    injected flaky opens. The run must finish having consumed every
+    sealed line exactly once — pinned the strong way: the final table
+    is BIT-IDENTICAL to a clean single-pass control run over the same
+    sealed corpus — and at least 2 ``published`` pointer flips must
+    land on manifest-verified steps."""
+    import threading
+    from fast_tffm_tpu.checkpoint import read_published
+    from fast_tffm_tpu.testing.faults import (flaky_open,
+                                              preempt_after_steps)
+    from fast_tffm_tpu.train import train
+    from tools.fmckpt import cmd_verify
+    workdir = os.path.abspath(workdir)
+    sd = os.path.join(workdir, "stream")
+    os.makedirs(sd, exist_ok=True)
+    n_shards, lines_per = 6, 400
+    shard_lines = [_corpus_lines(lines_per, seed * 100 + i)
+                   for i in range(n_shards)]
+
+    def writer():
+        for i in range(n_shards):
+            _append_shard_torn(os.path.join(sd, f"part-{i:03d}.txt"),
+                               shard_lines[i], pause=0.03)
+        open(os.path.join(sd, "STOP"), "w").close()
+
+    cfg = _stream_cfg(workdir, sd, publish_interval_seconds=0.25,
+                      io_retries=3)
+    w = threading.Thread(target=writer, name="stream-writer",
+                         daemon=True)
+    w.start()
+    # Run 1: stream against the LIVE writer (torn writes in flight);
+    # SIGTERM after 8 steps — mid-stream by construction (8 * 32 = 256
+    # of 2400 lines).
+    with preempt_after_steps(8) as st:
+        train(cfg)
+    assert st["fired"], "SIGTERM injector never fired"
+    assert _verdict(cfg) == "PREEMPTED", _verdict(cfg)
+    w.join(timeout=120)
+    assert not w.is_alive(), "stream writer never finished"
+    # Run 2: resume from the watermark; the first opens of a
+    # not-yet-consumed shard fail transiently (EIO) — the retry layer
+    # must absorb them.
+    with flaky_open(2, match="part-003.txt") as fstate:
+        table_stream = np.asarray(train(cfg))
+    assert fstate["failures"] == 2, fstate
+    # Exactly-once: total stepped examples across both run segments
+    # equals the corpus exactly (no line lost at the preemption cut,
+    # none double-trained on resume) ...
+    c = _counters(cfg)
+    total = n_shards * lines_per
+    assert c.get("train/examples") == total, (
+        c.get("train/examples"), total)
+    assert c.get("io/retries", 0) >= 2, c.get("io/retries")
+    # >= rather than ==: files the first segment discovered AFTER its
+    # last adopted watermark are legitimately re-discovered (and
+    # re-sealed) by the resumed segment's tracker, so the folded
+    # counters can exceed the shard count — the exactness claims live
+    # in train/examples and the bit-identity check.
+    assert c.get("stream/files_discovered", 0) >= n_shards, c
+    assert c.get("stream/files_sealed", 0) >= n_shards, c
+    # ... and the strong form: bit-identical to a clean single-pass
+    # control run over the same sealed corpus.
+    ctl_dir = os.path.join(workdir, "ctl")
+    os.makedirs(ctl_dir, exist_ok=True)
+    ctl = _cfg(ctl_dir, "", shuffle=False, epoch_num=1,
+               train_files=(os.path.join(sd, "part-*.txt"),))
+    table_ctl = np.asarray(train(ctl))
+    assert np.array_equal(table_stream, table_ctl), (
+        "stream run diverged from the clean single-pass control: "
+        f"max |delta| = {np.abs(table_stream - table_ctl).max()}")
+    # Publishing: >= 2 pointer flips across the two segments, and the
+    # final published pointer names a step fmckpt verify passes FULL.
+    publishes = int(c.get("stream/publishes", 0))
+    assert publishes >= 2, c
+    assert not c.get("stream/publish_failures"), c
+    ckpt_dir = cfg.model_file + ".ckpt"
+    pub = read_published(ckpt_dir)
+    assert pub is not None
+    assert cmd_verify(ckpt_dir, mode="full", step=pub) == 0, (
+        f"published step {pub} failed full verification")
+    return (f"consumed {total} sealed lines exactly once across "
+            f"SIGTERM+resume (torn writes held back, 2 flaky opens "
+            f"absorbed), table bit-identical to the control, "
+            f"{publishes} verified publishes (pointer at step {pub})")
+
+
+def scenario_stream_truncate(workdir: str, seed: int = 0) -> str:
+    """An in-progress (unsealed) stream file SHRINKS under the reader:
+    the (inode, size) regression is detected, the file is sealed at
+    the consumed position and the event is quarantined through the
+    BadLineTracker — the run survives, finishes the rest of the
+    stream, and the breaker accounting is exact (1 bad record, no
+    trip)."""
+    import json as _json
+    from fast_tffm_tpu.testing.faults import preempt_after_steps
+    from fast_tffm_tpu.train import train
+    workdir = os.path.abspath(workdir)
+    sd = os.path.join(workdir, "stream")
+    os.makedirs(sd, exist_ok=True)
+    growing = os.path.join(sd, "part-000.txt")
+    lines = _corpus_lines(100, seed)
+    with open(growing, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    # Run 1: tail the growing (UNSEALED — no .done) file; preempt after
+    # 3 steps = 96 lines consumed, watermark mid-file.
+    cfg = _stream_cfg(workdir, sd, bad_line_policy="quarantine",
+                      save_steps=0)
+    with preempt_after_steps(3) as st:
+        train(cfg)
+    assert st["fired"]
+    # The fault: the in-progress file shrinks BELOW the consumed
+    # position (a rewriting producer), a sealed successor shard
+    # arrives, and the stream ends.
+    with open(growing, "r+") as fh:
+        fh.truncate(len("\n".join(lines[:50])) + 1)
+    _write_corpus(os.path.join(sd, "part-001.txt"), 320,
+                  seed + 1)
+    open(os.path.join(sd, "part-001.txt.done"), "w").close()
+    open(os.path.join(sd, "STOP"), "w").close()
+    # Run 2: must detect the regression, quarantine it, and survive.
+    train(cfg)
+    c = _counters(cfg)
+    assert c.get("stream/truncated_files") == 1, c
+    assert c.get("pipeline/bad_lines") == 1, c
+    # 96 lines before the cut + the whole successor shard, never the
+    # vanished tail: exactly-once accounting around the damage.
+    assert c.get("train/examples") == 96 + 320, c
+    assert _verdict(cfg) == "OK", _verdict(cfg)
+    qpath = cfg.metrics_file + ".quarantine"
+    with open(qpath) as fh:
+        recs = [_json.loads(ln) for ln in fh if ln.strip()]
+    assert len(recs) == 1 and recs[0]["file"] == growing, recs
+    assert "truncated" in recs[0]["error"], recs
+    log = open(cfg.log_file).read()
+    assert "truncated mid-stream" in log
+    return ("in-progress file shrank 100 -> 50 lines at consumed line "
+            "96: sealed at the watermark, 1 quarantine record, no "
+            "breaker trip, run finished the successor shard (416 "
+            "examples exactly once)")
+
+
 # --- multi-worker compute-plane scenarios --------------------------------
 
 
@@ -757,6 +969,8 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "flaky-open": scenario_flaky_open,
     "flaky-open-parallel": scenario_flaky_open_parallel,
     "preempt-resume": scenario_preempt_resume,
+    "stream-soak": scenario_stream_soak,
+    "stream-truncate": scenario_stream_truncate,
     "truncate-latest": scenario_truncate_latest,
     "kill-async-save": scenario_kill_async_save,
     "kill-worker-midwindow": scenario_kill_worker_midwindow,

@@ -36,7 +36,8 @@ from typing import Dict, List, Optional
 
 from fast_tffm_tpu.checkpoint import (QUARANTINE_PREFIX, list_step_dirs,
                                       read_epoch_override, read_manifest,
-                                      sidecar_step, verify_step_dir)
+                                      read_published, sidecar_step,
+                                      verify_step_dir, watermark_path)
 
 
 def resolve_ckpt_dir(path: str) -> str:
@@ -84,6 +85,12 @@ def scan(directory: str) -> Dict[str, object]:
             "manifest": man is not None,
             "epoch": override if override is not None else epoch,
             "vocab": man.get("vocab") if man else None,
+            # Stream runs leave a watermark sidecar per step; ls flags
+            # it so an operator can see which steps can resume the
+            # stream position. Existence only — parsing every sidecar
+            # just for a flag would make a plain ls read (and warn on)
+            # payloads it doesn't need.
+            "watermark": os.path.exists(watermark_path(directory, s)),
         })
     quarantined: List[Dict[str, object]] = []
     orphans: List[str] = []
@@ -104,7 +111,10 @@ def scan(directory: str) -> Dict[str, object]:
         if s is not None and s not in kept:
             orphans.append(name)
     return {"directory": directory, "steps": steps,
-            "quarantined": quarantined, "orphans": orphans}
+            "quarantined": quarantined, "orphans": orphans,
+            # Stream-mode publish pointer (README "Streaming / online
+            # learning"): the step a scorer should be serving.
+            "published": read_published(directory)}
 
 
 def _fmt_bytes(n: int) -> str:
@@ -129,9 +139,20 @@ def cmd_ls(directory: str, as_json: bool = False, out=None) -> int:
         man = "manifest" if s["manifest"] else "NO MANIFEST (legacy)"
         epoch = "?" if s["epoch"] is None else s["epoch"]
         vocab = "?" if s["vocab"] is None else s["vocab"]
+        marks = ""
+        if s.get("watermark"):
+            marks += " +watermark"
+        if state.get("published") == s["step"]:
+            marks += "  PUBLISHED"
         out.write(f"  step {s['step']:<10} {s['files']:>4} files "
                   f"{_fmt_bytes(s['bytes']):>10}  epoch={epoch} "
-                  f"vocab={vocab}  {man}\n")
+                  f"vocab={vocab}  {man}{marks}\n")
+    if (state.get("published") is not None
+            and state["published"] not in {s["step"]
+                                           for s in state["steps"]}):
+        out.write(f"  published -> step {state['published']} "
+                  "(MISSING: the pointed-at step is gone — GC'd or "
+                  "quarantined since the publish)\n")
     for q in state["quarantined"]:
         out.write(f"  {q['name']:<15} {q['files']:>4} files "
                   f"{_fmt_bytes(q['bytes']):>10}  QUARANTINED "

@@ -17,9 +17,14 @@ the heartbeat lease on additionally get a per-worker liveness table
 (last heartbeat age, lockstep windows, examples; LOST flag on workers
 named by a ``worker_lost`` diagnosis) and the
 ``DEGRADED (N workers lost)`` health verdict (README "Elastic
-multi-host"). ``--json`` emits the merged summary + attribution as one
-JSON object for scripting. ``--tail`` follows a live file and
-pretty-prints events as they land.
+multi-host"). Streaming runs (``run_mode = stream``) get a STREAMING
+section — watermark lag, files discovered/sealed/truncated/deleted,
+publishes, last-publish age — and the health verdict reads
+``STALE PUBLISH`` when the last publish age exceeds 3x the configured
+interval (the serving fleet is reloading stale state). ``--json``
+emits the merged summary + attribution as one JSON object for
+scripting. ``--tail`` follows a live file and pretty-prints events as
+they land.
 """
 
 from __future__ import annotations
