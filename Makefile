@@ -8,6 +8,8 @@
 #   make bench      run the benchmark (one JSON line)
 #   make bench-host standalone host-only 1/2/4-worker sweep of the
 #                   parallel data plane (no device needed)
+#   make bench-predict  standalone predict line: cross-file streaming
+#                   scorer trials + its host_threads 1/2/4 sweep
 #   make lint       fmlint whole-program pass (R000-R010) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
@@ -35,6 +37,9 @@ bench: $(SO)
 bench-host: $(SO)
 	JAX_PLATFORMS=cpu python bench.py --host-sweep
 
+bench-predict: $(SO)
+	python bench.py --predict
+
 lint:
 	python -m tools.fmlint
 
@@ -47,4 +52,4 @@ stream-soak: $(SO)
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host lint chaos stream-soak clean
+.PHONY: all test bench bench-host bench-predict lint chaos stream-soak clean

@@ -333,20 +333,29 @@ def run_predict_e2e(cfg):
     """Batch-scoring throughput — the reference's second workload
     (SURVEY §3.4: file -> parse(keep_empty, line-aligned) -> score ->
     ordered scores): examples/sec over full sweeps of the headline file
-    through the real predict path (fast_tffm_tpu.predict.predict_scores,
-    chunked device fetches included). Sweep 0 pays the compiles and is
-    discarded. ``cfg`` comes from _line_cfg (stamp/measurement unity)."""
+    through the real predict path (the cross-file streaming scorer:
+    fast_tffm_tpu.predict.predict_scores, chunked overlap fetches
+    included). Sweep 0 pays the compiles and is discarded; then the
+    same 1/2/4 ``host_threads`` regime search the train headline runs
+    (keep_empty rides the parallel host plane since ISSUE 10) picks the
+    best worker count, and TRIALS full sweeps run there. Returns
+    (trial rates, best host_threads, search dict). ``cfg`` comes from
+    _line_cfg (stamp/measurement unity)."""
     from fast_tffm_tpu.models.fm import init_table
     from fast_tffm_tpu.predict import predict_scores
     table = init_table(cfg, 0)
-    rates = []
-    for i in range(TRIALS + 1):
+
+    def one_sweep(c):
         t0 = time.perf_counter()
-        scores = predict_scores(cfg, table, cfg.train_files)
-        dt = time.perf_counter() - t0
-        if i:
-            rates.append(scores.shape[0] / dt)
-    return rates
+        scores = predict_scores(c, table, c.train_files)
+        return scores.shape[0] / (time.perf_counter() - t0)
+
+    one_sweep(cfg)  # compile warmup, discarded
+    search = {w: one_sweep(_with_workers(cfg, w))
+              for w in HOST_WORKER_SWEEP}
+    best = max(search, key=search.get)
+    cfg = _with_workers(cfg, best)
+    return [one_sweep(cfg) for _ in range(TRIALS)], best, search
 
 
 def regime_stamp(cfg):
@@ -438,7 +447,14 @@ def _run_line(name, train_path):
     elif name == "hashed":
         out["trials"] = run_hashed_e2e(cfg)
     elif name == "predict":
-        out["trials"] = run_predict_e2e(cfg)
+        trials, best, search = run_predict_e2e(cfg)
+        out["trials"] = trials
+        # The predict sweep's OWN data-plane regime (chosen by its
+        # search — keep_empty batches are a different build shape from
+        # the train headline's, so its best worker count is its own).
+        out["host_threads"] = best
+        out["host_threads_search"] = {str(w): round(v, 1)
+                                      for w, v in search.items()}
     elif name == "l64":
         out["trials"] = cfg_e2e_trials(cfg)
     else:
@@ -677,6 +693,18 @@ def main():
         "predict_e2e": med(pred),
         "predict_e2e_trials":
             [round(v, 1) for v in pred] if pred else None,
+        # The predict gap, PINNED (ISSUE 10 acceptance): predict sweep
+        # rate over the train headline on the same chip. BENCH_r05
+        # measured 0.068 (65.8k vs 968.7k — the per-file teardown
+        # pipeline); the streaming scorer must keep this from silently
+        # regressing toward it.
+        "predict_vs_train_ratio":
+            round(med(pred) / eps, 4) if pred and eps else None,
+        # The predict sweep's own data-plane regime search (keep_empty
+        # on the parallel host plane).
+        "predict_host_threads": predict_res.get("host_threads"),
+        "predict_host_threads_search":
+            predict_res.get("host_threads_search"),
         "k16_e2e": med(k16),
         "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
         "l64_e2e": med(l64),
@@ -726,6 +754,35 @@ def host_sweep_main():
     }))
 
 
+def predict_sweep_main():
+    """Standalone predict line (`make bench-predict` / `python bench.py
+    --predict`): TRIALS full sweeps of the cross-file streaming scorer
+    on the headline corpus shape, plus its 1/2/4 ``host_threads``
+    regime search — one JSON line, without the ~6 other lines the full
+    bench pays for. The pinned ``predict_vs_train_ratio`` lives in the
+    full artifact (`python bench.py`), where the train headline it
+    divides by is measured in the same run."""
+    import tempfile
+    _enable_compile_cache()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "train.txt")
+        lines = synth_lines((N_WARM + N_TIMED) * B, 1 << 20)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        del lines
+        res = _run_line("predict", path)
+    trials = res["trials"]
+    print(json.dumps({
+        "metric": "predict_examples_per_sec_per_chip",
+        "value": round(statistics.median(trials), 1),
+        "unit": "examples/sec",
+        "predict_e2e_trials": [round(v, 1) for v in trials],
+        "host_threads": res["host_threads"],
+        "host_threads_search": res["host_threads_search"],
+        "regime": res["regime"],
+    }))
+
+
 if __name__ == "__main__":
     import sys
     if len(sys.argv) > 1 and sys.argv[1] == "--line":
@@ -734,5 +791,7 @@ if __name__ == "__main__":
         _line_main(sys.argv[2], sys.argv[3])
     elif len(sys.argv) > 1 and sys.argv[1] == "--host-sweep":
         host_sweep_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--predict":
+        predict_sweep_main()
     else:
         main()

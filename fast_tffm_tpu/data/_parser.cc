@@ -597,8 +597,12 @@ extern "C" {
 // alignment); 5 = fm_bb_new num_threads param (threaded streaming
 // feed: parallel parse into a pending queue + serial drain); 6 =
 // fm_scan_examples (example-boundary scanner for the parallel host
-// data plane's per-batch line groups).
-int64_t fm_abi_version() { return 6; }
+// data plane's per-batch line groups); 7 = fm_parse_block keep_empty
+// param (block-parse path for the predict alignment mode — until this
+// the BLOCK parser had no blank-line-preserving mode, so every
+// tolerant/weighted keep_empty input fell back to the Python parser
+// and the tolerant keep_empty shape routed serial).
+int64_t fm_abi_version() { return 7; }
 
 // Scan complete lines of [blob, blob+blob_len) until `n_target` lines
 // that PRODUCE AN EXAMPLE have been seen. The counting rule must equal
@@ -655,10 +659,12 @@ int fm_auto_threads() {
 //   (+ fields[nnz] when field_aware — FFM `field:fid[:val]` tokens)
 // Caller allocates: labels/poses sized for the line count, ids/vals/
 // fields for the worst-case token count (cparser.py sizes them from the
-// blob). fields_out may be null when !field_aware.
+// blob). fields_out may be null when !field_aware. `keep_empty` turns
+// blank lines into zero-feature label-0 examples (the predict path's
+// one-score-per-input-line alignment), same rule as the BatchBuilder.
 int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
                    int hash_ids, int field_aware, int64_t field_num,
-                   int max_feats, int num_threads,
+                   int max_feats, int keep_empty, int num_threads,
                    int64_t* n_examples_out, int64_t* nnz_out,
                    float* labels_out, int32_t* poses_out, int32_t* ids_out,
                    float* vals_out, int32_t* fields_out, char* err_out,
@@ -673,7 +679,7 @@ int fm_parse_block(const char* blob, int64_t blob_len, int64_t vocab,
 
   std::vector<ShardOut> outs = parse_threaded(
       blob, blob + blob_len, 0, T, vocab, hash_ids != 0, field_aware != 0,
-      field_num, max_feats, /*keep_empty=*/false,
+      field_num, max_feats, keep_empty != 0,
       /*keep_linenos=*/false);
 
   for (const auto& o : outs) {

@@ -62,7 +62,7 @@ def _build() -> None:
 
 # Must equal fm_abi_version() in _parser.cc. Bump both together whenever
 # an exported signature changes.
-_ABI_VERSION = 6
+_ABI_VERSION = 7
 
 
 def _open_checked(path: Optional[str] = None) -> Optional[ctypes.CDLL]:
@@ -142,6 +142,7 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int64, ctypes.c_int,                 # vocab, hash flag
             ctypes.c_int, ctypes.c_int64,                 # field flag, count
             ctypes.c_int,                                 # max feats/example
+            ctypes.c_int,                                 # keep_empty
             ctypes.c_int,                                 # num threads
             ctypes.POINTER(ctypes.c_int64),               # out: n_examples
             ctypes.POINTER(ctypes.c_int64),               # out: nnz
@@ -214,10 +215,13 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
                      hash_feature_id: bool = False,
                      field_aware: bool = False, field_num: int = 0,
                      max_features_per_example: int = 0,
+                     keep_empty: bool = False,
                      num_threads: int = 0) -> ParsedBlock:
     """C++-accelerated ``parse_lines`` (FM and field-aware FFM formats).
-    Raises RuntimeError when the extension is unusable, ParseError on
-    malformed input."""
+    ``keep_empty`` preserves blank lines as zero-feature label-0
+    examples (the predict path's line alignment), matching the Python
+    parser bit-for-bit. Raises RuntimeError when the extension is
+    unusable, ParseError on malformed input."""
     lib = _load()
     # The output buffers below are sized from len(lines), but the C++
     # side splits the joined blob on '\n' — an EMBEDDED newline in one
@@ -228,6 +232,14 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
     # the example count equal to len(lines).
     lines = [ln.replace("\n", " ") if "\n" in ln else ln for ln in lines]
     blob = "\n".join(lines).encode("utf-8")
+    if keep_empty and lines:
+        # Terminate the final line: "a\nb".split('\n') drops no line in
+        # C++, but a trailing EMPTY line ("a\n".join ending in "") is
+        # invisible to the newline walk — and under keep_empty every
+        # input line owes an example. Harmless otherwise, but only
+        # keep_empty NEEDS it, so the strict path's blob stays
+        # byte-identical to what it always fed.
+        blob += b"\n"
     n_lines = len(lines)
     # Worst-case token count bounds the output buffers: a feature token is
     # at least 2 bytes ("i "), a line at least 2 ("0\n").
@@ -243,7 +255,7 @@ def parse_lines_fast(lines: Sequence[str], vocabulary_size: int,
     rc = lib.fm_parse_block(
         blob, len(blob), vocabulary_size, int(hash_feature_id),
         int(field_aware), field_num,
-        max_features_per_example, num_threads,
+        max_features_per_example, int(keep_empty), num_threads,
         ctypes.byref(n_ex), ctypes.byref(nnz),
         labels, poses, ids, vals, fields, errbuf, len(errbuf))
     tel = _tel()
@@ -302,9 +314,11 @@ def parse_lines_salvage(lines: Sequence[str], vocabulary_size: int,
     block minus those lines. Clean blocks pay zero extra cost; a block
     with a bad line pays one Python re-parse of that block only.
 
-    ``keep_empty`` blocks skip the C++ attempt outright (the block
-    parser has no blank-line-preserving mode; pipeline._parse_block
-    makes the same routing choice).
+    ``keep_empty`` rides the same layering since ABI 7 (fm_parse_block
+    grew the blank-line-preserving mode): a clean keep_empty block is
+    one C++ pass, and under ``keep_empty`` the Python retry replaces a
+    bad line with a zero-feature example instead of dropping it, so
+    predict's one-score-per-input-line alignment survives corruption.
 
     Pool-safe: every buffer here is per-call, the C++ block parser
     holds no global state, and the telemetry counters go through the
@@ -314,17 +328,17 @@ def parse_lines_salvage(lines: Sequence[str], vocabulary_size: int,
     """
     if bad_lines is None:
         bad_lines = []
-    if not keep_empty:
-        try:
-            return parse_lines_fast(
-                lines, vocabulary_size,
-                hash_feature_id=hash_feature_id,
-                field_aware=field_aware, field_num=field_num,
-                max_features_per_example=max_features_per_example)
-        except (OSError, RuntimeError):
-            pass  # C++ extension unavailable -> Python handles it all
-        except ParseError:
-            pass  # failing block -> tolerant Python retry below
+    try:
+        return parse_lines_fast(
+            lines, vocabulary_size,
+            hash_feature_id=hash_feature_id,
+            field_aware=field_aware, field_num=field_num,
+            max_features_per_example=max_features_per_example,
+            keep_empty=keep_empty)
+    except (OSError, RuntimeError):
+        pass  # C++ extension unavailable -> Python handles it all
+    except ParseError:
+        pass  # failing block -> tolerant Python retry below
     from fast_tffm_tpu.data.parser import parse_lines
     return parse_lines(
         lines, vocabulary_size, hash_feature_id=hash_feature_id,

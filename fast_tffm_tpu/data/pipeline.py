@@ -157,6 +157,39 @@ class DeviceBatch:
                 self.fields is not None)
 
 
+class FileMarks:
+    """Per-file example-offset ledger for a single-pass keep_empty sweep
+    — the cross-file streaming scorer's demux map (scoring.py).
+
+    The pipeline appends ``(path, examples_before)`` as each file STARTS
+    feeding; under ``keep_empty`` every line is exactly one example, so
+    file i's examples span ``[starts[i], starts[i+1])`` of the emitted
+    example stream (the last file ends at the sweep total). The
+    load-bearing ordering invariant, kept by every pipeline path: a
+    file's entry is appended BEFORE any batch containing that file's
+    first example is yielded — so by the time the consumer holds enough
+    ordered scores to cut file i, entry i+1 (if any) already exists.
+    The scanner-ahead parallel plane appends entries EARLIER than the
+    serial path would; earlier is always safe, later never happens.
+
+    Thread-safe: the producing side runs on the prefetch/scanner
+    thread, the reading side on the fetch worker — both under one
+    lock. Requires ``keep_empty`` (blank lines are examples), a single
+    epoch, and no shuffle; batch_iterator enforces all three."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._starts: List[Tuple[str, int]] = []
+
+    def start_file(self, path: str, examples_before: int) -> None:
+        with self._lock:
+            self._starts.append((path, int(examples_before)))
+
+    def snapshot(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return list(self._starts)
+
+
 def expand_files(patterns: Sequence[str]) -> List[str]:
     """File list with glob expansion, order-stable (reference configs list
     globs/comma lists; SURVEY Appendix A)."""
@@ -497,7 +530,8 @@ def _owned_start_line_index_for(path: str, start: int, _size: int,
 def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                 shard_index: int, num_shards: int,
                 keep_empty: bool = False,
-                retry: Optional[RetryPolicy] = None
+                retry: Optional[RetryPolicy] = None,
+                file_marks: Optional[FileMarks] = None
                 ) -> Iterator[Tuple[str, float, Tuple[str, int, int,
                                                       int]]]:
     """Yield (line, weight, source) triples for this shard, where
@@ -564,7 +598,13 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
                             f"line {lineno}") from None
                     yield line, w, (path, rel, shard_index, num_shards)
         return
+    yielded = 0
     for path in files:
+        if file_marks is not None:
+            # keep_empty sweeps yield one example per owned line, so
+            # the yielded count IS the example offset (batch_iterator
+            # rejects file_marks without keep_empty).
+            file_marks.start_file(path, yielded)
         start, end = shard_byte_range(path, shard_index, num_shards)
         rel = 0
         for line in _iter_range_lines(path, start, end, retry=retry):
@@ -573,6 +613,7 @@ def _iter_lines(files: Sequence[str], weight_files: Sequence[str],
             # only \x1c would read as blank here (skipped) but as a
             # parse-error line on the C++ fast path otherwise.
             if line.strip(WHITESPACE) or keep_empty:
+                yielded += 1
                 yield line, 1.0, (path, rel, shard_index, num_shards)
 
 
@@ -672,8 +713,17 @@ def host_parallel_workers(cfg: FmConfig, weight_files: Sequence[str] = (),
     if _fast_path_eligible(cfg, weight_files):
         return workers
     if (getattr(cfg, "bad_line_policy", "error") != "error"
-            and not keep_empty and not weight_files and not fixed_shape):
-        return workers  # tolerant generic plane
+            and not weight_files and not fixed_shape):
+        # Tolerant generic plane. keep_empty rides it too since the C++
+        # block parser grew the blank-line-preserving mode (ABI 7):
+        # chunk composition stays line-deterministic — under keep_empty
+        # a bad line becomes a zero-feature example instead of
+        # dropping, so boundaries can't shift at all — and the parse
+        # is the GIL-releasing C++ pass, so fanning it out is real
+        # parallelism (the old Python-parser route made keep_empty
+        # serial by routing; that was the shape predict's quarantine
+        # sweeps ran single-threaded).
+        return workers
     return 1
 
 
@@ -985,7 +1035,8 @@ class _GroupScanner:
 
     def __init__(self, files: Sequence[str], shard_index: int,
                  num_shards: int, B: int, keep_empty: bool,
-                 retry: Optional[RetryPolicy]):
+                 retry: Optional[RetryPolicy],
+                 file_marks: Optional[FileMarks] = None):
         self._files = list(files)
         self._fi = 0
         self._chunks: Optional[Iterator[bytes]] = None
@@ -995,6 +1046,7 @@ class _GroupScanner:
         self._keep_empty = keep_empty
         self._retry = retry
         self._si, self._ns = shard_index, num_shards
+        self._file_marks = file_marks
         self.lines = 0  # stream lines consumed into groups so far
         self.file_spans: List[Tuple[int, str, int, int]] = []
 
@@ -1056,6 +1108,12 @@ class _GroupScanner:
             # serial path's fed_lines at the same stream point.
             base = self.lines + self._buf.count(b"\n", self._pos)
             self.file_spans.append((base, path, start, end))
+            if self._file_marks is not None:
+                # base counts every stream line before this file; under
+                # keep_empty (the only file_marks mode) lines ARE
+                # examples, and a spill rewind re-counts to the same
+                # values — the recorded base never moves.
+                self._file_marks.start_file(path, base)
             self._chunks = _iter_owned_chunks(path, start, end,
                                               retry=self._retry)
 
@@ -1114,7 +1172,8 @@ def _parallel_fast_batch_iterator(cfg: FmConfig, files: List[str],
                                   num_shards: int, uniq_bucket: int,
                                   stats: Optional[SpillStats],
                                   raw_ids: bool, keep_empty: bool,
-                                  workers: int
+                                  workers: int,
+                                  file_marks: Optional[FileMarks] = None
                                   ) -> Iterator[DeviceBatch]:
     """Parallel host data plane, fast path: parse+hash+dedup+pack fans
     out across ``workers`` pool threads — each owning its own C++
@@ -1162,7 +1221,8 @@ def _parallel_fast_batch_iterator(cfg: FmConfig, files: List[str],
         for epoch in range(n_epochs):
             scanner = _GroupScanner(
                 epoch_file_order(files, shuffle, file_seed, epoch),
-                shard_index, num_shards, B, keep_empty, retry)
+                shard_index, num_shards, B, keep_empty, retry,
+                file_marks=file_marks)
             inflight: Dict[int, _Group] = {}
             order: collections.deque = collections.deque()
             scan_done = False
@@ -1217,7 +1277,8 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                          seed: Optional[int], fixed_shape: bool,
                          shard_index: int = 0, num_shards: int = 1,
                          uniq_bucket: int = 0,
-                         stats: Optional[SpillStats] = None
+                         stats: Optional[SpillStats] = None,
+                         file_marks: Optional[FileMarks] = None
                          ) -> Iterator[DeviceBatch]:
     """Chunked C++ fast path: raw file bytes stream straight into the
     C++ BatchBuilder (parse + hash + dedup + padded scatter in one native
@@ -1280,6 +1341,12 @@ def _fast_batch_iterator(cfg: FmConfig, bb, files: List[str], B: int,
                                               num_shards)
                 tail = b""
                 file_spans.append((fed_lines, path, start, end))
+                if file_marks is not None:
+                    # fed_lines at file start == examples before it
+                    # (keep_empty: every line is an example; batches
+                    # holding this file's lines are yielded only from
+                    # feeds AFTER this append).
+                    file_marks.start_file(path, fed_lines)
                 for chunk in _iter_owned_chunks(path, start, end,
                                                 retry=retry):
                     yield from feed_all(tail + chunk if tail else chunk)
@@ -1348,7 +1415,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    uniq_bucket: int = 0,
                    stats: Optional[SpillStats] = None,
                    raw_ids: bool = False,
-                   bad_lines: Optional[BadLineTracker] = None
+                   bad_lines: Optional[BadLineTracker] = None,
+                   file_marks: Optional[FileMarks] = None
                    ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files (see _batch_iterator_impl
     for the full contract). This wrapper is the pipeline's telemetry
@@ -1367,7 +1435,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                               keep_empty=keep_empty,
                               fixed_shape=fixed_shape,
                               uniq_bucket=uniq_bucket, stats=stats,
-                              raw_ids=raw_ids, bad_lines=bad_lines)
+                              raw_ids=raw_ids, bad_lines=bad_lines,
+                              file_marks=file_marks)
     tel = active()
     if tel is None:
         yield from it
@@ -1404,7 +1473,8 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
                          uniq_bucket: int = 0,
                          stats: Optional[SpillStats] = None,
                          raw_ids: bool = False,
-                         bad_lines: Optional[BadLineTracker] = None
+                         bad_lines: Optional[BadLineTracker] = None,
+                         file_marks: Optional[FileMarks] = None
                          ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files.
 
@@ -1452,6 +1522,16 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
     if raw_ids and fixed_shape:
         raise ValueError("raw_ids (dedup=device) has no fixed-U protocol; "
                          "multi-process mode needs dedup=host")
+    if file_marks is not None:
+        # The ledger maps example offsets to files; that mapping only
+        # exists for a single in-order keep_empty pass (one example per
+        # line, no reordering, no re-reads).
+        if not keep_empty or do_shuffle or n_epochs != 1 or weight_files:
+            raise ValueError(
+                "file_marks requires keep_empty=True, a single epoch, "
+                "no shuffle, and no weight sidecars (the per-file "
+                "example-offset ledger is only meaningful for an "
+                "in-order one-example-per-line pass)")
 
     # Chunked C++ fast path (see _fast_batch_iterator): applies whenever
     # no feature needs per-line Python handling — including sharded
@@ -1469,7 +1549,7 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
             yield from _parallel_fast_batch_iterator(
                 cfg, files, B, n_epochs, do_shuffle, seed, fixed_shape,
                 shard_index, num_shards, uniq_bucket, stats, raw_ids,
-                keep_empty, workers)
+                keep_empty, workers, file_marks=file_marks)
             return
         try:
             bb = _make_builder(cfg, B, raw_ids, keep_empty, fixed_shape,
@@ -1480,11 +1560,12 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
             yield from _fast_batch_iterator(cfg, bb, files, B, n_epochs,
                                             do_shuffle, seed, fixed_shape,
                                             shard_index, num_shards,
-                                            uniq_bucket, stats=stats)
+                                            uniq_bucket, stats=stats,
+                                            file_marks=file_marks)
             return
-    # keep_empty needs blank lines to become zero-feature examples; only
-    # the Python parser implements that.
-    parse = None if keep_empty else parse_lines_fast
+    # Blank-line-preserving parse rides the C++ block parser too since
+    # ABI 7 (keep_empty mode); _parse_block threads the flag through.
+    parse = parse_lines_fast
     retry = RetryPolicy.from_config(cfg)
     tracker = bad_lines
     own_tracker = False
@@ -1542,15 +1623,17 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
     # by every worker, so the max_bad_fraction breaker and the
     # quarantine (file, lineno) dedupe stay global; only the ORDER of
     # quarantine records may interleave across workers — the set is
-    # identical, pinned by the parity tests. Weighted, keep_empty, and
-    # fixed-shape inputs stay serial (GIL-bound pairing, Python-parser
-    # blanks, and the spill-requeue's sequential composition).
+    # identical, pinned by the parity tests. keep_empty rides the pool
+    # too (ABI 7: the C++ parser preserves blanks, and a bad line
+    # becomes a zero-feature example — boundaries can't shift at all);
+    # weighted and fixed-shape inputs stay serial (GIL-bound pairing
+    # and the spill-requeue's sequential composition).
     pool: Optional[_BuildRing] = None
     pool_order: collections.deque = collections.deque()
     if tracker is not None and workers > 1:
         # workers > 1 already folds in the route conditions (C++
-        # available, no weights/keep_empty/fixed_shape) via
-        # host_parallel_workers above.
+        # available, no weights/fixed_shape; keep_empty allowed since
+        # ABI 7) via host_parallel_workers above.
         def _pool_work(_state, payload):
             raw_chunk, precounted = payload
             chunk, block, w = parse_chunk(raw_chunk,
@@ -1661,7 +1744,7 @@ def _batch_iterator_impl(cfg: FmConfig, files: Sequence[str],
                                      file_seed, epoch),
                     weight_files,
                     shard_index, num_shards, keep_empty=keep_empty,
-                    retry=retry):
+                    retry=retry, file_marks=file_marks):
                 if do_shuffle:
                     buf.append(item)
                     if len(buf) >= max(cfg.queue_size, B):
@@ -1809,9 +1892,10 @@ def gil_bound_iteration(cfg: FmConfig, weight_files: Sequence[str] = (),
     by GIL-holding Python work — the SAME path selection
     batch_iterator makes (_fast_path_eligible), exposed so prefetch
     callers can gate the worker thread on it. That happens when the
-    C++ extension is unavailable, on the generic path's one parse=None
-    case (keep_empty without the fast path), and on the WEIGHTED path:
-    its block parse is C++ (GIL released) but the per-line weight
+    C++ extension is unavailable, on the generic keep_empty shapes
+    (their block parse is C++ since ABI 7, but the per-line Python
+    iteration of _iter_lines still holds the GIL), and on the WEIGHTED
+    path: its block parse is C++ (GIL released) but the per-line weight
     pairing (readline/float/strip and a Python yield per line) holds
     the GIL — threading it on a single core is the contention class
     the gate exists to passthrough."""
@@ -1932,7 +2016,8 @@ def _parse_block(lines: Sequence[str], cfg: FmConfig, fast_parse,
                 lines, cfg.vocabulary_size,
                 hash_feature_id=cfg.hash_feature_id,
                 field_aware=field_aware, field_num=cfg.field_num,
-                max_features_per_example=cfg.max_features_per_example)
+                max_features_per_example=cfg.max_features_per_example,
+                keep_empty=keep_empty)
         except (OSError, RuntimeError):
             pass  # C++ extension unavailable -> Python fallback
     return parse_lines(

@@ -311,13 +311,25 @@ def score_body(spec: ModelSpec, table, uniq_ids, local_idx, vals,
     """Inference forward (gather -> scorer). Shared by the single-device
     and mesh-sharded score functions — single source of truth, like
     train_step_body. dedup='device': raw ids in ``local_idx``,
-    ``uniq_ids=None``, unique runs on device."""
+    ``uniq_ids=None`` — and NO device unique: dedup buys the forward
+    pass nothing (its U is padded to B*L+1, so ``table[uniq]`` moves
+    the same bytes a direct raw gather moves) while its sort-based
+    ``jnp.unique`` over B*L ids dominated the whole predict sweep
+    (measured on the bench chip: 179 ms vs 5.3 ms per B=8192 batch —
+    the single biggest term of BENCH_r05's 15x predict-vs-train gap).
+    The direct gather is BIT-identical: same table rows summed in the
+    same slot order. Training keeps ``_device_dedup`` — the backward
+    scatter needs unique rows for exact sparse Adagrad."""
     if spec.dedup == "device":
         if uniq_ids is not None:
             raise ValueError(
                 "dedup=device scorer got a host-deduped batch (uniq_ids "
                 "is set); build batches with raw_ids=True")
-        uniq_ids, local_idx = _device_dedup(spec, local_idx)
+        B, L = local_idx.shape
+        gathered = table[local_idx.ravel()]
+        idx = jnp.arange(B * L, dtype=jnp.int32).reshape(B, L)
+        return rows_score_body(spec, gathered, idx, vals, fields,
+                               mesh=mesh)
     gathered = table[uniq_ids]
     return rows_score_body(spec, gathered, local_idx, vals, fields,
                            mesh=mesh)

@@ -213,6 +213,31 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
     out["predict_examples"] = p_ex
     out["predict_examples_per_sec"] = _frac(p_ex, p_s)
     out["predict_fetch_depth_p90"] = depth.get("p90")
+    # Predict attribution (ISSUE 10 satellite): per-stage busy seconds
+    # over the sweep wall — parse/build on the pipeline thread(s), D2H
+    # bulk fetches (+ in-order delivery) on the fetch worker, score
+    # writes on the writer thread. The stages OVERLAP by design (the
+    # streaming scorer's whole point), so the shares are independent
+    # utilizations that may sum past 1; the stage whose share
+    # approaches 1 is the sweep's bound — a named verdict instead of
+    # the old fetch-depth guess. predict/seconds is counted once per
+    # sweep, so these are honest wall fractions — but ONLY on a
+    # predict-only stream (loop_s == 0, the same gate the verdict
+    # uses): a combined train-then-predict file feeds
+    # pipeline/build_seconds and fetch/d2h_seconds from the train
+    # loop and its validation sweeps too, which would inflate the
+    # shares past any meaning.
+    if p_s and p_ex and loop_s <= 0:
+        out["predict_parse_share"] = _frac(
+            c.get("pipeline/build_seconds"), p_s)
+        out["predict_d2h_share"] = _frac(
+            c.get("fetch/d2h_seconds"), p_s)
+        out["predict_write_share"] = _frac(
+            c.get("predict/write_seconds"), p_s)
+    else:
+        out["predict_parse_share"] = None
+        out["predict_d2h_share"] = None
+        out["predict_write_share"] = None
 
     # Bench ceilings, when the stream carries them (bench.py emits
     # these; a production run can be laid side by side with them).
@@ -255,16 +280,37 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# A predict stage whose busy share of the sweep wall exceeds this is
+# named the bound; below it the sweep's time is in score dispatch +
+# device compute, which host-side timing cannot split further.
+PREDICT_STAGE_BOUND_FRACTION = 0.5
+
+
 def _predict_verdict(att: Dict[str, Any]) -> str:
-    """Verdict for a predict-only stream. The output-order buffer
-    (ChunkedFetcher) backs up exactly when D2H transfer lags scoring —
-    a saturated depth histogram names the transfer as the bottleneck
-    (BASELINE.md "Predict-path rate"); a shallow one means the sweep
-    keeps up and the rate is scoring/host-bound."""
+    """Verdict for a predict-only stream, from the per-stage busy
+    shares (parse / D2H / write over sweep wall — ISSUE 10): the stage
+    saturating the wall is the bound, BY NAME. Streams without the
+    stage counters (pre-refactor files) fall back to the fetch-depth
+    heuristic: the output-order buffer (ChunkedFetcher) backs up
+    exactly when D2H transfer lags scoring (BASELINE.md "Predict-path
+    rate")."""
     rate = att.get("predict_examples_per_sec")
     base = (f"predict: {rate:,.0f} examples/sec over "
             f"{att['predict_examples']:,.0f} examples"
             if rate else "predict stream without rate data")
+    stages = [(name, att.get(key)) for name, key in
+              (("parse", "predict_parse_share"),
+               ("d2h", "predict_d2h_share"),
+               ("write", "predict_write_share"))]
+    known = [(n, v) for n, v in stages if v is not None]
+    if known:
+        name, share = max(known, key=lambda kv: kv[1])
+        detail = ", ".join(f"{n} {v:.0%}" for n, v in known)
+        if share > PREDICT_STAGE_BOUND_FRACTION:
+            return (base + f" — {name}-bound: {share:.0%} of the sweep "
+                    f"wall is {name} ({detail})")
+        return (base + " — score/dispatch-bound: no host stage "
+                f"saturates the sweep ({detail})")
     p90 = att.get("predict_fetch_depth_p90")
     from fast_tffm_tpu.utils.fetch import FETCH_CHUNK_BATCHES
     if p90 is not None and p90 >= FETCH_CHUNK_BATCHES:
@@ -552,6 +598,12 @@ def render(summary: Dict[str, Any]) -> str:
              att["predict_examples_per_sec"]),
             ("predict fetch-depth p90 (batches)",
              att["predict_fetch_depth_p90"]),
+            # Per-stage busy share of the sweep wall (stages overlap;
+            # the one near 1.0 is the bound — see _predict_verdict).
+            ("predict parse / d2h / write share",
+             f"{_fmt(att['predict_parse_share'])} / "
+             f"{_fmt(att['predict_d2h_share'])} / "
+             f"{_fmt(att['predict_write_share'])}"),
         ]
     for k, v in rows:
         lines.append(f"  {k:<34} {_fmt(v)}")

@@ -365,6 +365,28 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     filler_gargs = None  # device assembly of the all-padding batch is
     # identical every filler step — ship it once, not once per step
     # (H2D is the documented bottleneck on a tunnelled chip)
+    pending_prev: list = []  # previous window's dispatched scores,
+    # fetched AFTER the next window is dispatched (see _drain below)
+
+    def _drain(pending):
+        """Window-deferred bulk fetch: every queued score vector of a
+        PREVIOUS window materializes host-side here, after the next
+        window's programs were already dispatched — so the D2H drain
+        overlaps that window's device compute AND the following fill's
+        host parse, instead of serializing between them (the cross-file
+        predict sweep feeds one continuous stream through this loop;
+        without the deferral every window boundary stalled on the
+        fetch). One span for the whole drain. Guarded: fetching a
+        score whose producing program can never complete (dead peer
+        mid-window) blocks exactly like the dispatch would."""
+        if not pending:
+            return []
+        with span("lockstep/score_fetch", batches=len(pending)):
+            return guarded_collective(
+                lambda: [(batch, local_rows(score))
+                         for batch, score in pending],
+                label="lockstep/score_fetch")
+
     while True:
         window = []
         t_fill = _time.perf_counter()
@@ -393,6 +415,11 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
             # Coordinated preemption: every process computed the SAME
             # gathered flags, so all return here together — no program
             # of this window was dispatched, collectives stay matched.
+            # The previous window's deferred scores drain first (local
+            # device_get, no collective): they completed, so they are
+            # yielded, not re-done after resume.
+            for batch, local in _drain(pending_prev):
+                yield batch, local
             if tel is not None:
                 tel.count("lockstep/preempted_windows")
             return
@@ -412,6 +439,10 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
             tel.count("lockstep/window_fill_seconds",
                       _time.perf_counter() - t_fill)
         if rounds == 0:
+            # Every process ran dry in the same round: drain the last
+            # deferred window and end the sweep.
+            for batch, local in _drain(pending_prev):
+                yield batch, local
             return
         pending = []
         for i in range(rounds):
@@ -441,16 +472,12 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         if tel is not None:
             tel.count("lockstep/examples",
                       sum(b.num_real for b in window))
-        # Round-end bulk fetch: every queued score vector materializes
-        # host-side here (the deferred D2H the window exists to
-        # amortize) — one span for the whole drain. Guarded: fetching
-        # a score whose producing program can never complete (dead
-        # peer mid-window) blocks exactly like the dispatch would.
-        with span("lockstep/score_fetch", batches=len(pending)):
-            fetched = guarded_collective(
-                lambda: [(batch, local_rows(score))
-                         for batch, score in pending],
-                label="lockstep/score_fetch")
+        # Drain the PREVIOUS window (this window's programs are already
+        # in flight, so its compute overlaps this D2H); this window's
+        # scores stay queued on device until the next round — at most
+        # one extra window of [B_global] f32 vectors held in HBM.
+        fetched = _drain(pending_prev)
+        pending_prev = pending
         for batch, local in fetched:
             # This process's rows of the global [B_global] score vector
             # are exactly its local batch (global_batch concatenates

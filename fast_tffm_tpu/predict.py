@@ -1,10 +1,17 @@
 """Predict driver — the ``py/fm_predict.py`` equivalent (SURVEY.md §3.4).
 
-Restores the latest checkpoint at the config's ``model_file``, streams the
-predict files through parser + scorer, and writes one score per input
-line, order-preserving — sigmoid-transformed for logistic loss, raw for
-mse. ``score_path`` is treated as a directory; each input file ``f``
-produces ``<score_path>/<basename(f)>.score``.
+Restores the latest checkpoint at the config's ``model_file``, streams
+the predict files through parser + scorer, and writes one score per
+input line, order-preserving — sigmoid-transformed for logistic loss,
+raw for mse. ``score_path`` is treated as a directory; each input file
+``f`` produces ``<score_path>/<basename(f)>.score``.
+
+Both drivers run ONE continuous batch stream across ALL predict files
+(fast_tffm_tpu/scoring.py): file N's disk write, file N+1's D2H, file
+N+2's scoring, and file N+3's parse all overlap — no per-file fetcher
+drain, no per-file warmup, no per-file telemetry barrier (README
+"Predict path"; the pre-refactor per-file loop was the 15x
+predict-vs-train gap BENCH_r05 measured).
 """
 
 from __future__ import annotations
@@ -18,94 +25,13 @@ import numpy as np
 
 from fast_tffm_tpu.checkpoint import CheckpointState
 from fast_tffm_tpu.config import FmConfig
-from fast_tffm_tpu.data.pipeline import (batch_iterator, expand_files,
-                                         gil_bound_iteration, prefetch)
+from fast_tffm_tpu.data.pipeline import expand_files
 from fast_tffm_tpu.metrics import sigmoid
-from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
-                                     make_batch_scorer, ships_raw_batches)
 from fast_tffm_tpu.obs.telemetry import (active, make_telemetry,
                                          pop_active, push_active)
 from fast_tffm_tpu.obs.trace import span
-from fast_tffm_tpu.utils.fetch import ChunkedFetcher
+from fast_tffm_tpu.scoring import ScoreWriter, score_sweep
 from fast_tffm_tpu.utils.logging import get_logger
-
-# Output-order buffer depth buckets (batches retained between bulk
-# fetches): powers of two up to 4x FETCH_CHUNK_BATCHES.
-_DEPTH_BUCKETS = tuple(2 ** i for i in range(11))
-
-
-class _ScoreWriter:
-    """Ordered score-file writer on a small background thread, so the
-    next file's parse/score/D2H overlaps the previous file's disk
-    write instead of serializing behind it (the first bite of the
-    predict-gap roadmap item). Submission order IS write order (one
-    queue, one writer), the queue is bounded (at most 2 files' scores
-    buffered), and ``close()`` in the caller's finally flushes
-    everything and surfaces any deferred write error — a predict()
-    return means every score file is on disk. Each write is a
-    ``predict/write`` span on the ``fm-score-writer`` track in
-    fmtrace."""
-
-    def __init__(self, logger):
-        import queue
-        import threading
-        self._logger = logger
-        self._q: "queue.Queue" = queue.Queue(maxsize=2)
-        self._sentinel = object()
-        self._lock = threading.Lock()  # guards _error (worker writes,
-        # submit/close read; fmlint R008)
-        self._error: Optional[BaseException] = None
-        self._closed = False
-        self._thread = threading.Thread(target=self._run,
-                                        name="fm-score-writer",
-                                        daemon=True)
-        self._thread.start()
-
-    def _run(self) -> None:
-        from fast_tffm_tpu.obs.trace import span
-        while True:
-            job = self._q.get()
-            if job is self._sentinel:
-                return
-            with self._lock:
-                dead = self._error is not None
-            if dead:
-                # Drain-and-discard: the run is already doomed (the
-                # error surfaces at the next submit()/close()); keep
-                # unblocking producers, stop burning I/O on writes
-                # that would land beside a failed one.
-                continue
-            out_path, vals = job
-            try:
-                with span("predict/write",
-                          path=os.path.basename(out_path)):
-                    with open(out_path, "w") as fh:
-                        for v in vals:
-                            fh.write(f"{v:.6f}\n")
-                self._logger.info("wrote %d scores to %s", len(vals),
-                                  out_path)
-            except BaseException as e:  # surfaced at submit()/close()
-                with self._lock:
-                    if self._error is None:  # keep the FIRST failure
-                        self._error = e
-
-    def submit(self, out_path: str, vals: np.ndarray) -> None:
-        with self._lock:
-            err = self._error
-        if err is not None:
-            raise err
-        self._q.put((out_path, vals))
-
-    def close(self, raise_error: bool = True) -> None:
-        if not self._closed:
-            self._closed = True
-            self._q.put(self._sentinel)
-            self._thread.join()
-        if raise_error:
-            with self._lock:
-                err = self._error
-            if err is not None:
-                raise err
 
 
 def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
@@ -142,50 +68,14 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     mesh, the batch is data-sharded and scored against the row-sharded
     table in place (table shape [ckpt_rows, D]). With a lookup
     ``backend`` (lookup.HostOffloadLookup), rows are gathered host-side
-    and only [U, D] blocks reach the device (``table`` is unused)."""
-    spec = ModelSpec.from_config(cfg)
-    score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
-    raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
-    # keep_empty: blank input lines become zero-feature examples so the
-    # score file stays line-aligned with the input (SURVEY §3.4).
-    # Chunked fetches (utils/fetch.py): per-batch syncs are ruinous over
-    # a tunnelled link, whole-file buffering is unbounded.
+    and only [U, D] blocks reach the device (``table`` is unused).
+
+    A thin collector over scoring.score_sweep — the same continuous
+    cross-file stream predict() writes files from, concatenated."""
     out: List[np.ndarray] = []
-    # overlap=True: chunk N's D2H transfer rides a background thread
-    # while this loop dispatches chunk N+1's scoring — without it the
-    # sweep serializes on the fetch (measured: the single dominant cost
-    # of predict_e2e on this link; BASELINE.md "Predict-path rate").
-    fetcher = ChunkedFetcher(lambda s, num_real: out.append(s[:num_real]),
-                             overlap=True)
-    tel = active()
-    # try/finally (ADVICE round 5): an exception mid-sweep must not
-    # leave the overlap worker parked on queue.get forever with a
-    # queued chunk of device score arrays pinned in HBM — close()
-    # drains and joins the worker without masking the original error.
-    try:
-        for batch in prefetch(batch_iterator(cfg, files, training=False,
-                                             epochs=1, keep_empty=True,
-                                             raw_ids=raw),
-                              depth=cfg.prefetch_depth,
-                              gil_bound=gil_bound_iteration(
-                                  cfg, keep_empty=True)):
-            args = batch_args(batch)
-            args.pop("labels"), args.pop("weights")
-            fetcher.add(score_fn(table, args), batch.num_real)
-            if tel is not None:
-                tel.count("predict/batches")
-                tel.count("predict/examples", batch.num_real)
-                # Output-order buffer: device score arrays held back so
-                # results land in input order — its depth is the D2H
-                # backlog (BASELINE.md "Predict-path rate").
-                tel.observe("predict/fetch_depth", fetcher.pending_depth,
-                            bounds=_DEPTH_BUCKETS)
-                # Watchdog beat: a scored batch is progress
-                # (obs/health.py).
-                tel.heartbeat()
-        fetcher.flush()
-    finally:
-        fetcher.close()
+    score_sweep(cfg, table, files,
+                on_file=lambda _path, vals: out.append(vals),
+                mesh=mesh, backend=backend)
     return (np.concatenate(out) if out
             else np.zeros(0, dtype=np.float32))
 
@@ -277,6 +167,11 @@ def predict(cfg: FmConfig, table: Optional[jax.Array] = None,
         pop_active(tel_prev)
 
 
+def _score_out_path(cfg: FmConfig, path: str) -> str:
+    return os.path.join(cfg.score_path,
+                        os.path.basename(path) + ".score")
+
+
 def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
     tel = active()
     if jax.process_count() > 1:
@@ -320,59 +215,87 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
     if table is None and backend is None:
         table = load_table(cfg, mesh)
     os.makedirs(cfg.score_path, exist_ok=True)
-    written = []
-    # Writer thread (see _ScoreWriter): file N's disk write overlaps
-    # file N+1's parse/score/D2H. The inner close() surfaces deferred
-    # write errors on the clean path; the finally's close is the
-    # idempotent no-mask flush for the error path.
-    writer = _ScoreWriter(logger)
-    try:
-        for path in expand_files(cfg.predict_files):
-            # fmlint: disable=R003 -- feeds the predict/seconds counter
-            # and per-file rate gauge (always-on aggregates; the span
-            # beside it is the timeline view)
-            t0 = time.perf_counter()
-            with span("predict/file", path=os.path.basename(path)):
-                raw = predict_scores(cfg, table, [path], mesh=mesh,
-                                     backend=backend)
-            # fmlint: disable=R003 -- closes the predict/seconds sample
+    files = expand_files(cfg.predict_files)
+    written: List[str] = []
+    # Writer thread (see scoring.ScoreWriter): file N's disk write
+    # overlaps file N+1's parse/score/D2H. The inner close() surfaces
+    # deferred write errors on the clean path; the finally's close is
+    # the idempotent no-mask flush for the error path.
+    writer = ScoreWriter(logger)
+    # fmlint: disable=R003 -- brackets the whole sweep for the
+    # predict/seconds counter and rate gauge (always-on aggregates;
+    # the predict/sweep span inside score_sweep is the timeline view)
+    t0 = time.perf_counter()
+    emitted = [0]  # cumulative examples cut so far (single-writer:
+    # on_file runs on one thread at a time — score_sweep's contract)
+
+    def on_file(path: str, raw: np.ndarray) -> None:
+        # Runs on the fetch worker thread mid-sweep (score_sweep's
+        # contract): the transform is vectorized numpy, the submit is
+        # a bounded queue put, the telemetry emit is thread-safe —
+        # nothing here stalls the device loop beyond backpressure.
+        vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
+        out_path = _score_out_path(cfg, path)
+        writer.submit(out_path, vals)
+        written.append(out_path)
+        emitted[0] += len(raw)
+        if tel is not None:
+            # Per-file wall time no longer exists (files overlap — that
+            # is the point), so seconds/rate report the sweep so far
+            # at this file's cut.
+            # fmlint: disable=R003 -- closes the sweep-rate sample
             dt = time.perf_counter() - t0
-            vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
-            out_path = os.path.join(cfg.score_path,
-                                    os.path.basename(path) + ".score")
-            writer.submit(out_path, vals)
-            written.append(out_path)
-            if tel is not None:
-                rate = len(raw) / dt if dt > 0 else 0.0
-                tel.count("predict/seconds", dt)
-                tel.set("predict/examples_per_sec", rate)
-                tel.sink.emit("predict_file",
-                              {"path": path, "examples": len(raw),
-                               "seconds": dt, "examples_per_sec": rate})
-                # Per-file barrier: scores are already host-side here,
-                # so the flush is pure file I/O.
-                tel.barrier_flush(step=len(written))
+            tel.sink.emit("predict_file",
+                          {"path": path, "examples": len(raw),
+                           "seconds": dt,
+                           "examples_per_sec":
+                               emitted[0] / dt if dt > 0 else 0.0})
+
+    try:
+        n = score_sweep(cfg, table, files, on_file=on_file, mesh=mesh,
+                        backend=backend)
         writer.close()
     finally:
         writer.close(raise_error=False)
+    # fmlint: disable=R003 -- closes the predict/seconds sample
+    dt = time.perf_counter() - t0
+    rate = n / dt if dt > 0 else 0.0
+    if tel is not None:
+        tel.count("predict/seconds", dt)
+        tel.set("predict/examples_per_sec", rate)
+        # One barrier for the sweep (scores are host-side; the flush
+        # is pure file I/O) — the per-file barriers the old loop paid
+        # serialized the stream once per file.
+        tel.barrier_flush(step=len(written))
+    logger.info("predict sweep: %d files, %d examples, %.0f examples/s",
+                len(written), n, rate)
     return written
 
 
 def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
-    """Sharded predict: every process scores its byte-range input shard
-    through the global-mesh score fn in lockstep (each call is a
-    collective program — the filler-batch protocol from distributed
-    validation keeps uneven shards from deadlocking), writes its ordered
-    part file, and the chief concatenates parts in process order (byte
-    ranges are contiguous: process i's lines all precede process
-    i+1's)."""
+    """Sharded predict, one continuous stream: every process scores its
+    byte-range shard of ALL files through the global-mesh score fn in
+    lockstep (each call is a collective program — the filler-batch
+    protocol from distributed validation keeps uneven shards from
+    deadlocking), demuxes its ordered local scores into per-file part
+    files through the bounded writer thread, and the CHIEF's background
+    merge thread concatenates parts in process order as each file's
+    markers land (byte ranges are contiguous: process i's lines all
+    precede process i+1's) — so the merge of file N overlaps the
+    scoring of file N+1. Three sweep-level barriers (stale-part scrub,
+    parts done, merge done) replace the old two barriers per file."""
     from jax.experimental import multihost_utils
-    from fast_tffm_tpu.data.pipeline import (probe_uniq_bucket,
+    from fast_tffm_tpu.data.pipeline import (FileMarks,
+                                             batch_iterator,
+                                             probe_uniq_bucket,
                                              require_bounded_examples)
+    from fast_tffm_tpu.models.fm import ModelSpec
     from fast_tffm_tpu.parallel.liveness import guarded_collective
     from fast_tffm_tpu.parallel.sharded import (lockstep_score_batches,
                                                 make_mesh,
                                                 make_sharded_score_fn)
+    from fast_tffm_tpu.scoring import (PartMerger, ScoreDemux,
+                                       scrub_stale_parts)
     require_bounded_examples(cfg, "multi-process predict")
     mesh = make_mesh()
     if cfg.batch_size % mesh.shape["data"]:
@@ -388,74 +311,102 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
     p, P = jax.process_index(), jax.process_count()
     os.makedirs(cfg.score_path, exist_ok=True)
     tel = active()
-    written: List[str] = []
-    for path in expand_files(cfg.predict_files):
-        # fmlint: disable=R003 -- feeds the per-worker predict/seconds
-        # counter (always-on aggregate)
-        t0 = time.perf_counter()
-        # Deterministic probe: every process reads the same bytes, so
-        # all agree on U without a collective.
-        ub = cfg.uniq_bucket or probe_uniq_bucket(cfg, [path])
-        it = batch_iterator(cfg, [path], training=False, epochs=1,
-                            keep_empty=True, shard_index=p, num_shards=P,
-                            fixed_shape=True, uniq_bucket=ub)
-        local: List[np.ndarray] = []
-        with span("predict/file", path=os.path.basename(path)):
-            for batch, scores in lockstep_score_batches(cfg, it, mesh,
-                                                        score_fn, table,
-                                                        ub):
-                local.append(scores[:batch.num_real])
+    files = expand_files(cfg.predict_files)
+    if not files:
+        # Only an empty predict_files tuple reaches here (a non-matching
+        # glob stays a literal path and fails loudly at the probe's
+        # open). expand_files is deterministic, so every process returns
+        # uniformly — no collective divergence. The sweep-level probe
+        # below would otherwise IndexError; the old per-file loop just
+        # never entered.
+        logger.warning("predict_files is empty; nothing to score")
+        return []
+    out_paths = [_score_out_path(cfg, f) for f in files]
+    # ONE uniq-bucket decision per sweep (probe_uniq_bucket samples the
+    # first/last/largest file — deterministic bytes, so every process
+    # agrees without a collective). The old per-file probe re-read
+    # every file's head/mid/tail before scoring it AND recompiled
+    # nothing it couldn't have shared — the "double read" half of the
+    # predict gap.
+    ub = cfg.uniq_bucket or probe_uniq_bucket(cfg, files)
+    marks = FileMarks()
+    it = batch_iterator(cfg, files, training=False, epochs=1,
+                        keep_empty=True, shard_index=p, num_shards=P,
+                        fixed_shape=True, uniq_bucket=ub,
+                        file_marks=marks)
+    # Parts/markers left by a CRASHED prior sweep into the same
+    # score_path would satisfy the merger's marker polls instantly and
+    # merge the old run's scores as if fresh — the chief scrubs them
+    # (any part index, markers included), and the barrier keeps every
+    # worker's first fresh part behind the scrub.
+    if p == 0:
+        stale = scrub_stale_parts(out_paths)
+        if stale:
+            logger.warning(
+                "removed %d stale part file(s) from a prior predict "
+                "sweep into %s (first: %s)", len(stale),
+                cfg.score_path, stale[0])
+    guarded_collective(multihost_utils.sync_global_devices,
+                       "predict_parts_clean",
+                       label="predict/clean_barrier")
+    writer = ScoreWriter(logger)
+    merger = PartMerger(out_paths, P, logger) if p == 0 else None
+    # fmlint: disable=R003 -- brackets the whole sweep for the
+    # per-worker predict/seconds counter (always-on aggregate)
+    t0 = time.perf_counter()
+    n_local = 0
+
+    def on_file(path: str, raw: np.ndarray) -> None:
+        vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
+        out_path = _score_out_path(cfg, path)
+        part = f"{out_path}.part{p}"
+        # The marker is created only after the part file is durably
+        # written+closed — the chief's merge thread keys on it.
+        writer.submit(part, vals, marker=f"{part}.done")
+        if tel is not None:
+            tel.count("predict/examples", len(raw))
+            tel.sink.emit("predict_file",
+                          {"path": path, "examples": len(raw),
+                           "process_index": p})
+
+    demux = ScoreDemux(marks, on_file)
+    try:
+        with span("predict/sweep", files=len(files)):
+            for batch, local in lockstep_score_batches(cfg, it, mesh,
+                                                       score_fn, table,
+                                                       ub):
+                demux.consume(local[:batch.num_real])
+                n_local += batch.num_real
                 if tel is not None:
                     tel.heartbeat()  # lockstep progress feeds the
                     # watchdog; a hung peer stalls the whole cluster
-        raw = (np.concatenate(local) if local
-               else np.zeros(0, dtype=np.float32))
-        vals = sigmoid(raw) if cfg.loss_type == "logistic" else raw
-        out_path = os.path.join(cfg.score_path,
-                                os.path.basename(path) + ".score")
-        part = f"{out_path}.part{p}"
-        with open(part, "w") as fh:
-            for v in vals:
-                fh.write(f"{v:.6f}\n")
-        tag = os.path.basename(path)
+        demux.finalize()
+        writer.close()  # every part + marker of this worker is on disk
         guarded_collective(multihost_utils.sync_global_devices,
-                           f"predict_parts_{tag}",
+                           "predict_parts_done",
                            label="predict/parts_barrier")
-        if p == 0:
-            n = 0
-            # Stream the merge in bounded chunks: reading a whole part
-            # with fh.read() holds multi-GB strings on the chief for
-            # billion-line predicts.
-            with open(out_path, "wb") as out_fh:
-                for i in range(P):
-                    with open(f"{out_path}.part{i}", "rb") as fh:
-                        while True:
-                            chunk = fh.read(8 << 20)
-                            if not chunk:
-                                break
-                            n += chunk.count(b"\n")
-                            out_fh.write(chunk)
-            logger.info("wrote %d scores to %s (merged %d parts)",
-                        n, out_path, P)
-        # Chief must finish reading every part before anyone deletes.
+        if merger is not None:
+            # All markers are durable past the barrier: the merge
+            # thread finishes its remaining files promptly (bounded
+            # per-marker grace; a missing marker raises by name).
+            merger.finish()
+        # Chief finished reading (and deleting) every part before
+        # anyone returns and could rewrite/reuse the score dir.
         guarded_collective(multihost_utils.sync_global_devices,
-                           f"predict_merged_{tag}",
+                           "predict_merged",
                            label="predict/merge_barrier")
-        os.remove(part)
-        written.append(out_path)
-        if tel is not None:
-            # Per-WORKER rate for this worker's shard; the merged view
-            # (fmstat over all .p<i> shards) sums examples and seconds
-            # across processes, keyed by process index in the metadata.
-            # fmlint: disable=R003 -- closes the predict/seconds sample
-            dt = time.perf_counter() - t0
-            n_local = len(raw)
-            tel.count("predict/seconds", dt)
-            tel.count("predict/examples", n_local)
-            tel.set("predict/examples_per_sec",
-                    n_local / dt if dt > 0 else 0.0)
-            tel.sink.emit("predict_file",
-                          {"path": path, "examples": n_local,
-                           "seconds": dt, "process_index": p})
-            tel.barrier_flush(step=len(written))
-    return written
+    finally:
+        writer.close(raise_error=False)
+        if merger is not None:
+            merger.stop()
+    if tel is not None:
+        # Per-WORKER rate for this worker's shard; the merged view
+        # (fmstat over all .p<i> shards) sums examples and seconds
+        # across processes, keyed by process index in the metadata.
+        # fmlint: disable=R003 -- closes the predict/seconds sample
+        dt = time.perf_counter() - t0
+        tel.count("predict/seconds", dt)
+        tel.set("predict/examples_per_sec",
+                n_local / dt if dt > 0 else 0.0)
+        tel.barrier_flush(step=len(out_paths))
+    return out_paths

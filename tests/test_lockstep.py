@@ -105,12 +105,22 @@ def test_preempt_flag_stops_at_window_boundary(rig):
     """ISSUE 6 satellite: the preemption flag rides the fill allgather
     — a raised flag ends the sweep BEFORE any of that window's
     collective programs dispatch, so every process (all of them see
-    the same gathered flags) stops at the same boundary."""
+    the same gathered flags) stops at the same boundary.
+
+    Since the window-deferred score fetch (ISSUE 10), window W's
+    results reach the consumer only after window W+1 dispatched — so a
+    CONSUMER-DRIVEN flag like this one is first visible to the
+    allgather one window later than the consumer raised it, and the
+    sweep ends exactly one window past the flag (a real preemption
+    flag is signal-driven, not consumer-driven, so its boundary is
+    unchanged). Dispatched-but-undelivered windows still drain on the
+    preempt path: their work completed and is yielded, never redone."""
     cfg, mesh, table, score_fn, data, ub = rig
     windows_seen = []
 
     def preempt():
-        # flips true while the SECOND window is being agreed on
+        # flips true once the consumer has SEEN a full window — which,
+        # with the deferred fetch, happens while window 3 is agreed on
         return len(windows_seen) >= 1
 
     it = batch_iterator(cfg, [data], training=False, epochs=1,
@@ -122,9 +132,10 @@ def test_preempt_flag_stops_at_window_boundary(rig):
         out.append(batch)
         if len(out) % sharded.LOCKSTEP_WINDOW == 0:
             windows_seen.append(len(out))
-    # exactly the first window was scored; the second was cut at the
+    # windows 1 and 2 were scored (2 was in flight when the flag became
+    # visible and drains on the preempt path); window 3 was cut at the
     # boundary, before dispatch
-    assert len(out) == sharded.LOCKSTEP_WINDOW
+    assert len(out) == 2 * sharded.LOCKSTEP_WINDOW
 
 
 def test_preempt_flag_before_first_window_yields_nothing(rig):

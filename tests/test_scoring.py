@@ -1,0 +1,396 @@
+"""Cross-file streaming scorer == per-file scoring, bit for bit.
+
+The streaming sweep (fast_tffm_tpu/scoring.py) must be a PURE
+throughput change: for every input shape — C++ fast path, tolerant
+generic path, unbounded-features generic path, sharded fixed-U (spills
+included), multi-file batches that interleave neighbors, empty files —
+the per-file score arrays it demuxes out of one continuous batch
+stream must be bit-identical to scoring each file in its own sweep
+(the pre-refactor per-file protocol), for host_threads = 1 AND > 1.
+Plus the demux/writer/merger machinery contracts themselves.
+"""
+
+import logging
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data import cparser
+from fast_tffm_tpu.data.pipeline import FileMarks, batch_iterator
+from fast_tffm_tpu.scoring import (PartMerger, ScoreDemux, ScoreWriter,
+                                   score_sweep)
+
+needs_cpp = pytest.mark.skipif(not cparser.available(),
+                               reason="C++ parser extension unavailable")
+
+VOCAB = 300
+
+
+def _write(tmp_path, n, seed, name, blanks=True):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for i in range(n):
+        nnz = rng.integers(1, 12)
+        ids = rng.choice(VOCAB, size=nnz, replace=False)
+        lines.append(" ".join(["1" if rng.random() < 0.4 else "0"]
+                              + [f"{j}:{rng.random():.4f}" for j in ids]))
+        if blanks and i % 7 == 3:
+            lines.append("")  # blank line: scores stay line-aligned
+    p = tmp_path / name
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def _files(tmp_path, blanks=True):
+    """Three files sized so batches CROSS both boundaries at B=16 (the
+    middle file is smaller than one batch: its examples interleave
+    with both neighbors' inside single batches), plus one empty file
+    and one more regular file after it."""
+    a = _write(tmp_path, 40, 1, "a.txt", blanks)
+    b = _write(tmp_path, 5, 2, "b.txt", blanks=False)
+    empty = tmp_path / "c_empty.txt"
+    empty.write_text("")
+    d = _write(tmp_path, 23, 3, "d.txt", blanks)
+    return [a, b, str(empty), d]
+
+
+def _cfg(files, host_threads=1, **kw):
+    base = dict(vocabulary_size=VOCAB, factor_num=4, batch_size=16,
+                train_files=tuple(files), shuffle=False,
+                bucket_ladder=(4, 8, 16), max_features_per_example=16,
+                host_threads=host_threads)
+    base.update(kw)
+    return FmConfig(**base)
+
+
+def _table(cfg):
+    from fast_tffm_tpu.models.fm import init_table
+    return init_table(cfg, seed=7)
+
+
+def _line_count(path):
+    with open(path) as fh:
+        return sum(1 for _ in fh)
+
+
+def _streamed(cfg, table, files):
+    """One continuous sweep -> {path: raw scores} via the demux."""
+    out = {}
+    n = score_sweep(cfg, table, files,
+                    on_file=lambda p, v: out.__setitem__(p, v))
+    assert sorted(out) == sorted(files)
+    assert sum(len(v) for v in out.values()) == n
+    return out
+
+
+def _per_file(cfg, table, files):
+    """The pre-refactor protocol: every file in its own sweep."""
+    return {f: _streamed(cfg, table, [f])[f] for f in files}
+
+
+def _assert_file_parity(tmp_path, cfg_kw=None, blanks=True):
+    files = _files(tmp_path, blanks)
+    cfg = _cfg(files, 1, **(cfg_kw or {}))
+    table = _table(cfg)
+    ref = _per_file(cfg, table, files)
+    for ht in (1, 4):
+        got = _streamed(_cfg(files, ht, **(cfg_kw or {})), table, files)
+        for f in files:
+            assert got[f].tobytes() == ref[f].tobytes(), (
+                f"host_threads={ht}: {os.path.basename(f)} diverged")
+            # line alignment: one score per input line, empty incl.
+            assert len(got[f]) == _line_count(f)
+    return ref
+
+
+@needs_cpp
+def test_streaming_parity_fast_path(tmp_path):
+    ref = _assert_file_parity(tmp_path)
+    assert len(ref[[k for k in ref if k.endswith("c_empty.txt")][0]]) == 0
+
+
+def test_streaming_parity_generic_unbounded(tmp_path):
+    # max_features_per_example=0 stays on the generic per-line path
+    _assert_file_parity(tmp_path,
+                        cfg_kw=dict(max_features_per_example=0,
+                                    bucket_ladder=(16,)))
+
+
+@needs_cpp
+def test_streaming_parity_tolerant_keep_empty(tmp_path):
+    """bad_line_policy=skip under keep_empty (the shape that routed
+    SERIAL before the C++ block parser grew keep_empty in ABI 7): a
+    corrupt line becomes a zero-feature example — alignment kept —
+    and the parallel plane now applies, bit-identical to serial."""
+    files = _files(tmp_path)
+    # corrupt one mid-file line in a.txt
+    lines = open(files[0]).read().splitlines()
+    lines[11] = "not libsvm at all :::"
+    open(files[0], "w").write("\n".join(lines) + "\n")
+    _assert_file_parity(tmp_path, cfg_kw=dict(bad_line_policy="skip"))
+
+
+@needs_cpp
+def test_streaming_parity_sharded_fixed_u(tmp_path):
+    """The multi-process shape, emulated per shard: fixed-U sharded
+    streams over ALL files, demuxed per (shard, file) through
+    FileMarks, then parts concatenated in shard order per file — must
+    equal the unsharded per-file reference. uniq_bucket=64 on B=16
+    batches with up to 16 features forces SPILLS (batches close
+    early), the exact protocol the ledger must survive."""
+    from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
+                                         make_batch_scorer,
+                                         ships_raw_batches)
+    files = _files(tmp_path)
+    cfg = _cfg(files)
+    table = _table(cfg)
+    ref = _per_file(cfg, table, files)
+    spec = ModelSpec.from_config(cfg)
+    score_fn = make_batch_scorer(spec)
+    raw = ships_raw_batches(spec)
+    P = 3
+    for ht in (1, 4):
+        scfg = _cfg(files, ht)
+        parts = {f: [None] * P for f in files}
+        for p in range(P):
+            marks = FileMarks()
+            got = {}
+            demux = ScoreDemux(marks,
+                               lambda f, v, _g=got: _g.__setitem__(f, v))
+            it = batch_iterator(scfg, files, training=False, epochs=1,
+                                keep_empty=True, shard_index=p,
+                                num_shards=P, fixed_shape=True,
+                                uniq_bucket=64, raw_ids=raw,
+                                file_marks=marks)
+            for batch in it:
+                args = batch_args(batch)
+                args.pop("labels"), args.pop("weights")
+                s = np.asarray(score_fn(table, args))
+                demux.consume(s[:batch.num_real])
+            demux.finalize()
+            assert sorted(got) == sorted(files)
+            for f, v in got.items():
+                parts[f][p] = v
+        for f in files:
+            merged = np.concatenate(parts[f])
+            assert merged.tobytes() == ref[f].tobytes(), (
+                f"host_threads={ht}: sharded merge of "
+                f"{os.path.basename(f)} diverged")
+            assert len(merged) == _line_count(f)
+
+
+@needs_cpp
+def test_predict_e2e_multi_file_score_files(tmp_path):
+    """predict() end to end over the multi-file corpus: every file gets
+    its .score, line-aligned, including the ZERO-LINE file; file order
+    of the returned list matches input order; no writer thread leaks."""
+    from fast_tffm_tpu.predict import predict
+    files = _files(tmp_path)
+    cfg = _cfg(files, host_threads=4,
+               predict_files=tuple(files),
+               score_path=str(tmp_path / "score"),
+               model_file=str(tmp_path / "model" / "fm"))
+    written = predict(cfg, table=_table(cfg))
+    assert [os.path.basename(w)[:-len(".score")] for w in written] == [
+        os.path.basename(f) for f in files]
+    table = _table(cfg)
+    ref = _per_file(cfg, table, files)
+    from fast_tffm_tpu.metrics import sigmoid
+    for f, w in zip(files, written):
+        with open(w) as fh:  # loadtxt warns on the empty file
+            got = np.asarray([float(ln) for ln in fh if ln.strip()])
+        assert len(got) == _line_count(f)
+        exp = sigmoid(ref[f])
+        np.testing.assert_allclose(got, exp, atol=1e-6)
+    assert not [t.name for t in threading.enumerate()
+                if t.name in ("fm-score-writer", "fetcher")
+                and t.is_alive()]
+
+
+# ------------------------------------------------------------ demux units
+
+
+def _marks_of(entries):
+    m = FileMarks()
+    for path, start in entries:
+        m.start_file(path, start)
+    return m
+
+
+def test_demux_one_batch_cuts_many_files():
+    m = _marks_of([("a", 0), ("b", 3), ("c", 5), ("d", 5)])
+    got = []
+    d = ScoreDemux(m, lambda p, v: got.append((p, v.tolist())))
+    d.consume(np.arange(7, dtype=np.float32))
+    # a, b, and the EMPTY c all cut from the single consume; d waits
+    assert got == [("a", [0, 1, 2]), ("b", [3, 4]), ("c", [])]
+    d.finalize()
+    assert got[-1] == ("d", [5, 6])
+
+
+def test_demux_trailing_empty_files():
+    m = _marks_of([("a", 0), ("b", 2), ("c", 2)])
+    got = []
+    d = ScoreDemux(m, lambda p, v: got.append((p, len(v))))
+    d.consume(np.zeros(2, dtype=np.float32))
+    d.finalize()
+    assert got == [("a", 2), ("b", 0), ("c", 0)]
+
+
+def test_demux_no_files_no_scores():
+    d = ScoreDemux(_marks_of([]), lambda p, v: (_ for _ in ()).throw(
+        AssertionError("no files must emit nothing")))
+    d.finalize()
+
+
+def test_demux_late_entry_holds_cut():
+    """A file is only cut once its SUCCESSOR's ledger entry exists —
+    scores past the boundary wait, then cut retroactively."""
+    m = _marks_of([("a", 0)])
+    got = []
+    d = ScoreDemux(m, lambda p, v: got.append(p))
+    d.consume(np.zeros(5, dtype=np.float32))
+    assert got == []          # b not announced yet
+    m.start_file("b", 3)
+    d.consume(np.zeros(0, dtype=np.float32))
+    assert got == ["a"]       # announcement alone releases the cut
+    d.finalize()
+    assert got == ["a", "b"]
+
+
+# --------------------------------------------------- writer/merger units
+
+
+def test_score_writer_marker_after_file(tmp_path):
+    w = ScoreWriter(logging.getLogger("t"))
+    out = str(tmp_path / "x.score")
+    w.submit(out, np.asarray([0.25, 0.5], dtype=np.float32),
+             marker=out + ".done")
+    w.close()
+    assert open(out).read() == "0.250000\n0.500000\n"
+    assert os.path.exists(out + ".done")
+
+
+def test_score_writer_surfaces_write_error(tmp_path):
+    w = ScoreWriter(logging.getLogger("t"))
+    w.submit(str(tmp_path / "nope" / "x.score"),
+             np.zeros(1, dtype=np.float32))
+    with pytest.raises(OSError):
+        w.close()
+    w.close(raise_error=False)  # idempotent
+
+
+def test_part_merger_merges_in_order(tmp_path):
+    outs = [str(tmp_path / f"f{i}.score") for i in range(3)]
+    m = PartMerger(outs, num_parts=2, logger=logging.getLogger("t"))
+    # parts land out of file order — the merger still merges in order
+    for fi in (2, 0, 1):
+        for p in range(2):
+            part = f"{outs[fi]}.part{p}"
+            with open(part, "w") as fh:
+                fh.write(f"{fi}.{p}\n")
+            with open(part + ".done", "w"):
+                pass
+    assert m.finish() == outs
+    for fi, out in enumerate(outs):
+        assert open(out).read() == f"{fi}.0\n{fi}.1\n"
+    assert not [p for p in os.listdir(tmp_path) if ".part" in p]
+
+
+def test_part_merger_missing_marker_raises(tmp_path, monkeypatch):
+    import fast_tffm_tpu.scoring as scoring
+    monkeypatch.setattr(scoring, "_MERGE_GRACE_SECONDS", 0.2)
+    out = str(tmp_path / "f.score")
+    m = PartMerger([out], num_parts=2, logger=logging.getLogger("t"))
+    with open(out + ".part0", "w") as fh:
+        fh.write("x\n")
+    with open(out + ".part0.done", "w"):
+        pass
+    # part1 never arrives: finish() must raise naming the marker, not
+    # poll forever
+    with pytest.raises(FileNotFoundError, match="part1"):
+        m.finish()
+
+
+def test_scrub_stale_parts_removes_only_parts(tmp_path):
+    from fast_tffm_tpu.scoring import scrub_stale_parts
+    outs = [str(tmp_path / "a.score"), str(tmp_path / "b.score")]
+    # A crashed prior sweep's leavings: parts + markers, including a
+    # part index beyond this run's process count, and the merged score
+    # file itself (which a rerun legitimately overwrites — keep it).
+    keep = [outs[0], str(tmp_path / "unrelated.txt")]
+    stale = [outs[0] + ".part0", outs[0] + ".part0.done",
+             outs[0] + ".part7", outs[1] + ".part1.done"]
+    for path in keep + stale:
+        with open(path, "w") as fh:
+            fh.write("old\n")
+    removed = scrub_stale_parts(outs)
+    assert sorted(removed) == sorted(stale)
+    for path in stale:
+        assert not os.path.exists(path)
+    for path in keep:
+        assert os.path.exists(path)
+    assert scrub_stale_parts(outs) == []
+
+
+def test_part_merger_stop_is_clean(tmp_path):
+    m = PartMerger([str(tmp_path / "f.score")], num_parts=1,
+                   logger=logging.getLogger("t"))
+    m.stop()
+    assert not [t.name for t in threading.enumerate()
+                if t.name == "fm-part-merger" and t.is_alive()]
+
+
+# ------------------------------------------------------- fmstat verdict
+
+
+def test_predict_attribution_rows_and_verdict():
+    from fast_tffm_tpu.obs.attribution import attribution
+    base = {"counters": {"predict/examples": 1000,
+                         "predict/seconds": 2.0,
+                         "pipeline/build_seconds": 1.8,
+                         "fetch/d2h_seconds": 0.2,
+                         "predict/write_seconds": 0.1},
+            "gauges": {}, "hists": {}}
+    att = attribution(base)
+    assert att["predict_parse_share"] == pytest.approx(0.9)
+    assert att["predict_d2h_share"] == pytest.approx(0.1)
+    assert att["predict_write_share"] == pytest.approx(0.05)
+    assert "parse-bound" in att["verdict"]
+    # no stage saturating -> dispatch/score named, not guessed
+    base["counters"]["pipeline/build_seconds"] = 0.3
+    att = attribution(base)
+    assert "score/dispatch-bound" in att["verdict"]
+    # pre-refactor stream without the stage counters: heuristic kept
+    for k in ("pipeline/build_seconds", "fetch/d2h_seconds",
+              "predict/write_seconds"):
+        del base["counters"][k]
+    att = attribution(base)
+    assert att["predict_parse_share"] is None
+    assert "host/scoring-bound" in att["verdict"]
+
+
+def test_predict_attribution_gated_to_predict_only_streams():
+    # A combined train-then-predict metrics file feeds
+    # pipeline/build_seconds and fetch/d2h_seconds from the train loop
+    # and its validation sweeps too — the shares must go None (and the
+    # verdict stays the train verdict) instead of reporting the train
+    # loop's hours as a percentage of the predict sweep.
+    from fast_tffm_tpu.obs.attribution import attribution
+    base = {"counters": {"predict/examples": 1000,
+                         "predict/seconds": 2.0,
+                         "pipeline/build_seconds": 3600.0,
+                         "fetch/d2h_seconds": 40.0,
+                         "predict/write_seconds": 0.1,
+                         "train/examples": 500000},
+            "gauges": {},
+            "hists": {"train/step_seconds":
+                      {"sum": 3000.0, "count": 10000}}}
+    att = attribution(base)
+    assert att["predict_parse_share"] is None
+    assert att["predict_d2h_share"] is None
+    assert att["predict_write_share"] is None
+    assert "predict" not in att.get("verdict", "")

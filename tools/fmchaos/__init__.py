@@ -78,6 +78,16 @@ asserts the documented recovery behavior:
                       process (it stopped heartbeating without dying),
                       and the survivor exits with WorkerLostError —
                       never an indefinite hang.
+- ``predict-flaky``   the cross-file streaming scorer under faults:
+                      flaky opens on the first predict file plus one
+                      corrupt file mid-sweep with ``bad_line_policy =
+                      quarantine`` → the sweep completes, every OTHER
+                      file's scores are BIT-IDENTICAL to a fault-free
+                      sweep, the corrupt file's score file stays
+                      line-aligned (bad lines score as zero-feature
+                      examples), the quarantine sidecar names each
+                      injected line, and no writer/fetcher/build
+                      threads leak.
 
 The scenario functions are plain callables (workdir in, asserts
 inside) so tests/test_chaos.py runs the same soaks under tier-1; the
@@ -486,6 +496,84 @@ log_steps = 0
     return (f"SIGKILLed child at committed step {killed_at}; restart "
             f"restored cleanly and finished at step {final_steps[-1]} "
             f"(verdict {v!r})")
+
+
+def scenario_predict_flaky(workdir: str, seed: int = 0) -> str:
+    """ISSUE 10: the cross-file streaming scorer under faults. One
+    continuous sweep means one file's damage could in principle smear
+    into its neighbors' batches — this pins that it doesn't: flaky
+    opens + a quarantined-corrupt file mid-sweep leave every other
+    file's scores bit-identical and line-aligned, and the sweep's
+    writer/fetcher/build threads all exit."""
+    import threading
+    from fast_tffm_tpu.predict import predict
+    from fast_tffm_tpu.testing.faults import corrupt_corpus, flaky_open
+    from fast_tffm_tpu.train import train
+
+    data = os.path.join(workdir, "train.txt")
+    _write_corpus(data, 400, seed)
+    cfg = _cfg(workdir, data)
+    train(cfg)
+
+    preds = []
+    for i in range(3):
+        p = os.path.join(workdir, f"pred{i}.txt")
+        _write_corpus(p, 120, seed + 10 + i)
+        preds.append(p)
+    dirty_mid = os.path.join(workdir, "pred1_rotten.txt")
+    bad = corrupt_corpus(preds[1], dirty_mid, fraction=0.05, seed=seed)
+    assert bad, "corruption injection produced no bad lines"
+
+    # Fault-free reference sweep over the same outer files.
+    ref_cfg = dataclasses.replace(
+        cfg, predict_files=tuple(preds),
+        score_path=os.path.join(workdir, "score_ref"),
+        metrics_file=os.path.join(workdir, "ref_metrics.jsonl"))
+    predict(ref_cfg)
+
+    # Faulted sweep: transient opens on file 0, the corrupt file in
+    # the middle, quarantine policy, parallel host plane.
+    flt_cfg = dataclasses.replace(
+        cfg, predict_files=(preds[0], dirty_mid, preds[2]),
+        score_path=os.path.join(workdir, "score_flaky"),
+        metrics_file=os.path.join(workdir, "flaky_metrics.jsonl"),
+        bad_line_policy="quarantine", io_retries=3, host_threads=4)
+    with flaky_open(2, match="pred0.txt") as state:
+        predict(flt_cfg)
+    assert state["failures"] == 2, state
+
+    def _score_text(cfg_, name):
+        with open(os.path.join(cfg_.score_path, name + ".score")) as fh:
+            return fh.read()
+
+    # The files beside the damage: bit-identical to the clean sweep.
+    for name in ("pred0.txt", "pred2.txt"):
+        assert _score_text(flt_cfg, name) == _score_text(ref_cfg, name), (
+            f"{name} scores diverged beside a corrupt neighbor")
+    # The corrupt file itself: still one score per input line.
+    n_scores = len(_score_text(flt_cfg,
+                               "pred1_rotten.txt").splitlines())
+    with open(dirty_mid) as fh:
+        n_lines = sum(1 for _ in fh)
+    assert n_scores == n_lines, (n_scores, n_lines)
+    # Quarantine sidecar names each injected line of the corrupt file.
+    with open(flt_cfg.metrics_file + ".quarantine") as fh:
+        recs = [json.loads(ln) for ln in fh if ln.strip()]
+    assert sorted(r["lineno"] for r in recs) == [i + 1 for i in bad], (
+        f"quarantined {sorted(r['lineno'] for r in recs)} != injected "
+        f"{[i + 1 for i in bad]}")
+    assert all(r["file"] == dirty_mid for r in recs)
+    c = _counters(flt_cfg)
+    assert c.get("io/retries", 0) >= 2, c.get("io/retries")
+    leaked = [t.name for t in threading.enumerate()
+              if t.is_alive() and (t.name.startswith("fm-build")
+                                   or t.name in ("fm-score-writer",
+                                                 "fetcher"))]
+    assert not leaked, leaked
+    return (f"streaming sweep absorbed {state['failures']} flaky opens "
+            f"+ quarantined {len(recs)} corrupt line(s) mid-sweep; "
+            "neighbor scores bit-identical, alignment kept, no thread "
+            "leaks")
 
 
 # --- streaming run-mode scenarios ----------------------------------------
@@ -968,6 +1056,7 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "max-bad": scenario_max_bad,
     "flaky-open": scenario_flaky_open,
     "flaky-open-parallel": scenario_flaky_open_parallel,
+    "predict-flaky": scenario_predict_flaky,
     "preempt-resume": scenario_preempt_resume,
     "stream-soak": scenario_stream_soak,
     "stream-truncate": scenario_stream_truncate,
