@@ -15,6 +15,12 @@
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
 #   make stream-soak  the streaming run-mode scenarios standalone
 #                   (torn writes / SIGTERM+resume / truncation)
+#   make serve      run the online scorer on sample.cfg (needs a
+#                   published checkpoint: fmckpt publish, or a stream
+#                   trainer with publish_interval_seconds)
+#   make serve-soak the serving chaos scenario standalone (concurrent
+#                   requests across a hot reload, bit-identical to
+#                   batch predict)
 #   make clean
 
 CXX ?= g++
@@ -49,7 +55,13 @@ chaos: $(SO)
 stream-soak: $(SO)
 	JAX_PLATFORMS=cpu python -m tools.fmchaos stream-soak stream-truncate
 
+serve: $(SO)
+	python run_tffm.py serve sample.cfg
+
+serve-soak: $(SO)
+	JAX_PLATFORMS=cpu python -m tools.fmchaos serve-soak
+
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict lint chaos stream-soak clean
+.PHONY: all test bench bench-host bench-predict lint chaos stream-soak serve serve-soak clean

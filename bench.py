@@ -531,6 +531,79 @@ def _isolated_line(name, train_path):
     return {"trials": None, "device": None, "isolation": "failed"}
 
 
+# Serving-latency line shape: concurrent client threads x requests
+# each, small variable-size requests (the online traffic shape — the
+# admission queue's micro-batching is the thing under test).
+SERVE_CLIENTS = 8
+SERVE_REQUESTS_PER_CLIENT = 150
+
+
+def run_serve_latency(tmp):
+    """The serving path's bench line (README "Serving"): publish a
+    checkpoint, run the REAL ScorerServer (verified load + warmed
+    [B rung, L rung] ladder), fire concurrent variable-size requests
+    through the in-process client, and report the request-latency
+    p50/p99 the server's own histogram measured — the number the
+    ``serve_p99_ms`` row pins and fmstat's SERVING section shows in
+    production."""
+    import threading
+    from fast_tffm_tpu.checkpoint import CheckpointState
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.serve import ScoreClient, ScorerServer
+    wd = os.path.join(tmp, "serve")
+    os.makedirs(wd, exist_ok=True)
+    cfg = FmConfig(vocabulary_size=1 << 20, factor_num=8,
+                   max_features_per_example=48, bucket_ladder=(48,),
+                   model_file=os.path.join(wd, "fm"),
+                   serve_max_batch=256, serve_max_wait_ms=2.0,
+                   serve_poll_seconds=60.0)
+    rng = np.random.default_rng(0)
+    table = rng.standard_normal(
+        (cfg.ckpt_rows, cfg.row_dim)).astype(np.float32) * 0.01
+    ckpt = CheckpointState(cfg.model_file)
+    ckpt.save(1, table, np.full_like(table, 0.1),
+              vocabulary_size=cfg.vocabulary_size, wait=True)
+    ckpt.publish_step(1)
+    ckpt.close()
+    del table
+    req_pool = synth_lines(512, 1 << 20, seed=7)
+    server = ScorerServer(cfg, watch=False)
+    client = ScoreClient(server)
+    errors = []
+
+    def fire(worker):
+        r = np.random.default_rng(worker)
+        try:
+            for _ in range(SERVE_REQUESTS_PER_CLIENT):
+                k = int(r.integers(1, 9))
+                lo = int(r.integers(0, len(req_pool) - k))
+                client.score(req_pool[lo:lo + k], timeout=120)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(SERVE_CLIENTS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    stats = server.stats()
+    server.close()
+    if errors:
+        raise errors[0]
+    return {
+        "p50_ms": round(stats["latency_p50_ms"], 2),
+        "p99_ms": round(stats["latency_p99_ms"], 2),
+        "requests": stats["requests"],
+        "requests_per_sec": round(stats["requests"] / dt, 1),
+        "examples_per_sec": round(stats["examples"] / dt, 1),
+        "flushes": stats["flushes"],
+        "clients": SERVE_CLIENTS,
+    }
+
+
 def _make_bench_telemetry(cfg):
     """Optional run-telemetry stream (obs/) for the bench: set
     FM_METRICS_FILE to write the same JSONL schema production train/
@@ -649,6 +722,17 @@ def main():
         k16, k16_dev = k16_res["trials"], k16_res["device"]
         l64 = l64_res["trials"]
 
+        # Serving-path soak (ISSUE 11): the online scorer's request
+        # latency under concurrent clients — a LATENCY line beside the
+        # throughput lines above (`python bench.py --serve` standalone).
+        try:
+            serve_res = run_serve_latency(tmp)
+        except Exception as e:  # noqa: BLE001 - artifact survival
+            import sys
+            print(f"bench serve line failed ({type(e).__name__}: {e}); "
+                  f"recording null", file=sys.stderr)
+            serve_res = None
+
     def med(trials):  # None survives a timed-out line (see _isolated_line)
         return round(statistics.median(trials), 1) if trials else None
 
@@ -705,6 +789,15 @@ def main():
         "predict_host_threads": predict_res.get("host_threads"),
         "predict_host_threads_search":
             predict_res.get("host_threads_search"),
+        # The serving path's latency SLO numbers (README "Serving"):
+        # request-latency quantiles over SERVE_CLIENTS concurrent
+        # clients through the real admission queue + warmed ladder.
+        "serve_p50_ms": serve_res["p50_ms"] if serve_res else None,
+        "serve_p99_ms": serve_res["p99_ms"] if serve_res else None,
+        "serve_requests_per_sec":
+            serve_res["requests_per_sec"] if serve_res else None,
+        "serve_examples_per_sec":
+            serve_res["examples_per_sec"] if serve_res else None,
         "k16_e2e": med(k16),
         "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
         "l64_e2e": med(l64),
@@ -754,6 +847,22 @@ def host_sweep_main():
     }))
 
 
+def serve_latency_main():
+    """Standalone serving-latency line (`python bench.py --serve`):
+    the run_serve_latency soak without the ~7 other lines the full
+    bench pays for. One JSON line."""
+    import tempfile
+    _enable_compile_cache()
+    with tempfile.TemporaryDirectory() as tmp:
+        res = run_serve_latency(tmp)
+    print(json.dumps({
+        "metric": "serve_request_latency_ms",
+        "value": res["p99_ms"],
+        "unit": "ms (p99)",
+        **res,
+    }))
+
+
 def predict_sweep_main():
     """Standalone predict line (`make bench-predict` / `python bench.py
     --predict`): TRIALS full sweeps of the cross-file streaming scorer
@@ -793,5 +902,7 @@ if __name__ == "__main__":
         host_sweep_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--predict":
         predict_sweep_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
+        serve_latency_main()
     else:
         main()

@@ -175,6 +175,42 @@ def read_published(directory: str) -> Optional[int]:
         return None
 
 
+def write_published(directory: str, step: int) -> str:
+    """Atomically repoint the ``published`` pointer file at ``step`` —
+    the ONE pointer-write sequence (tmp + fsync + rename via
+    _atomic_write_text) shared by the stream driver's
+    ``CheckpointState.publish_step`` and the ``fmckpt publish``
+    operator path, so a concurrent reader (a serving process's reload
+    poll) always reads either the old complete value or the new one,
+    never a torn write. Callers own verification: repointing at an
+    unverified step is how a scorer loads garbage."""
+    path = os.path.join(directory, PUBLISHED_POINTER)
+    _atomic_write_text(path, f"{int(step)}\n")
+    return path
+
+
+def wait_for_published(directory: str, last: Optional[int] = None,
+                       timeout: Optional[float] = None,
+                       poll_seconds: float = 0.5) -> Optional[int]:
+    """Block until the ``published`` pointer names a step different
+    from ``last`` (None = any published step), polling the pointer
+    file. Returns the new step, or None on timeout. The pointer-watch
+    primitive the serving subsystem builds on (serve/reload.py polls
+    inline on its own thread; this helper is the blocking form for
+    server startup and tests). A garbled/unreadable pointer reads as
+    "not published yet" on that poll and heals on the next — the same
+    contract as read_published."""
+    deadline = (None if timeout is None
+                else time.monotonic() + float(timeout))
+    while True:
+        step = read_published(directory)
+        if step is not None and step != last:
+            return step
+        if deadline is not None and time.monotonic() >= deadline:
+            return None
+        time.sleep(poll_seconds)
+
+
 def list_step_dirs(directory: str) -> List[int]:
     """Committed step numbers by DIRECT directory listing: orbax commits
     a step by atomically renaming its tmp dir to the bare number, so a
@@ -637,8 +673,7 @@ class CheckpointState:
             if tel is not None:
                 tel.count("stream/publish_failures")
             return None
-        path = os.path.join(self.directory, PUBLISHED_POINTER)
-        _atomic_write_text(path, f"{int(step)}\n")
+        path = write_published(self.directory, step)
         tel = _tel()
         if tel is not None:
             tel.count("stream/publishes")

@@ -215,6 +215,34 @@ class FmConfig:
     predict_files: Tuple[str, ...] = ()
     score_path: str = "./score"
 
+    # --- [Serve] -----------------------------------------------------------
+    # Online serving (README "Serving"; fast_tffm_tpu/serve/): a
+    # long-lived scorer process that loads the ``published`` checkpoint
+    # step, micro-batches concurrent requests under a latency budget,
+    # and hot-reloads when the pointer moves. ``run_tffm.py serve``.
+    # Bind address for the stdlib HTTP front end. The default is
+    # loopback-only (safe out of the box); a real deployment — one
+    # server per host behind a load balancer — sets 0.0.0.0 (or the
+    # host's LB-facing interface) so off-host health checks and
+    # traffic can reach it.
+    serve_host: str = "127.0.0.1"
+    # TCP port for the stdlib HTTP front end (POST /score, GET
+    # /healthz). 0 = pick an ephemeral port (logged at startup).
+    serve_port: int = 7070
+    # Admission-queue flush cap: a micro-batch flushes as soon as this
+    # many examples are queued (or the wait budget expires). Also sizes
+    # the pre-compiled batch-width ladder (powers of two up to this),
+    # and bounds a single request's example count.
+    serve_max_batch: int = 256
+    # How long the first request in an admission window waits for
+    # company before the micro-batch flushes anyway — the knob that
+    # trades p50 latency for batching efficiency. 0 = flush immediately
+    # (every request scores alone).
+    serve_max_wait_ms: float = 5.0
+    # Hot-reload poll cadence: how often the server re-reads the
+    # ``published`` pointer file looking for a newly published step.
+    serve_poll_seconds: float = 2.0
+
     # --- [Cluster] ---------------------------------------------------------
     # Reference: ps_hosts/worker_hosts for the TF1 PS runtime (SURVEY §3.2).
     # Here retained for CLI compatibility; mapped onto jax.distributed
@@ -399,6 +427,26 @@ class FmConfig:
                 "stream_dir is set but run_mode is 'epochs'; set "
                 "run_mode = stream (or drop stream_dir) — a silently "
                 "ignored stream directory is always a config mistake")
+        if not self.serve_host:
+            raise ValueError(
+                "serve_host must be a bind address (127.0.0.1 for "
+                "loopback-only, 0.0.0.0 for all interfaces)")
+        if not 0 <= self.serve_port <= 65535:
+            raise ValueError(
+                f"serve_port must be in [0, 65535] (0 = ephemeral), "
+                f"got {self.serve_port}")
+        if self.serve_max_batch < 1:
+            raise ValueError(
+                f"serve_max_batch must be >= 1, got "
+                f"{self.serve_max_batch}")
+        if self.serve_max_wait_ms < 0:
+            raise ValueError(
+                f"serve_max_wait_ms must be >= 0 (0 = flush "
+                f"immediately), got {self.serve_max_wait_ms}")
+        if self.serve_poll_seconds <= 0:
+            raise ValueError(
+                f"serve_poll_seconds must be > 0, got "
+                f"{self.serve_poll_seconds}")
         if self.cluster_connect_timeout_seconds <= 0:
             raise ValueError(
                 f"cluster_connect_timeout_seconds must be > 0, got "
@@ -532,6 +580,13 @@ _PREDICT_KEYS = {
     "predict_files": _split_files,
     "score_path": str,
 }
+_SERVE_KEYS = {
+    "serve_host": str,
+    "serve_port": int,
+    "serve_max_batch": int,
+    "serve_max_wait_ms": float,
+    "serve_poll_seconds": float,
+}
 _CLUSTER_KEYS = {
     "ps_hosts": _split_files,
     "worker_hosts": _split_files,
@@ -558,7 +613,8 @@ def load_config(path: str) -> FmConfig:
     # The one section->keys mapping: drives both the consume loop and
     # the wrong-section hint, so the two cannot diverge.
     sections = {"General": _GENERAL_KEYS, "Train": _TRAIN_KEYS,
-                "Predict": _PREDICT_KEYS, "Cluster": _CLUSTER_KEYS}
+                "Predict": _PREDICT_KEYS, "Serve": _SERVE_KEYS,
+                "Cluster": _CLUSTER_KEYS}
 
     def consume(section: str, keys):
         if not cp.has_section(section):

@@ -205,6 +205,24 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
             "stream/publish_interval_seconds"),
     }
 
+    # Serving (README "Serving"; fast_tffm_tpu/serve/): request/latency
+    # accounting plus the served-vs-published step pair the STALE MODEL
+    # health verdict reads.
+    lat = h.get("serve/request_latency_ms") or {}
+    qd = h.get("serve/queue_depth") or {}
+    out["serve_requests"] = c.get("serve/requests", 0)
+    out["serve_examples"] = c.get("serve/examples", 0)
+    out["serve_flushes"] = c.get("serve/flushes", 0)
+    out["serve_flush_errors"] = c.get("serve/flush_errors", 0)
+    out["serve_padded_examples"] = c.get("serve/padded_examples", 0)
+    out["serve_reloads"] = c.get("serve/reloads", 0)
+    out["serve_reload_failures"] = c.get("serve/reload_failures", 0)
+    out["serve_latency_p50_ms"] = lat.get("p50")
+    out["serve_latency_p99_ms"] = lat.get("p99")
+    out["serve_queue_depth_p90"] = qd.get("p90")
+    out["serve_served_step"] = g.get("serve/served_step")
+    out["serve_published_step"] = g.get("serve/published_step")
+
     # Predict-path stats (a predict stream has no train loop at all;
     # both can coexist in one file — e.g. train-then-predict appends).
     p_ex = c.get("predict/examples", 0)
@@ -444,6 +462,21 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                      f"over 3x the {interval:.0f}s publish interval — "
                      "scorers are reloading stale state; check the "
                      "stream run's save/verify path"] + notes)}
+    lag = stale_model(summary)
+    if lag is not None:
+        # Same placement rationale as STALE PUBLISH: a live serving
+        # run legitimately has no run_end yet, and "the scorer is
+        # serving older state than the pointer names" is the
+        # actionable diagnosis — the reload loop is failing (verify
+        # failures, a GC'd step, a dead watcher), not the publisher.
+        served, published = lag
+        return {"verdict": "STALE MODEL",
+                "detail": "; ".join(
+                    [f"serving checkpoint step {served:.0f} while the "
+                     f"published pointer names step {published:.0f} — "
+                     "the hot-reload loop is not landing; check "
+                     "serve/reload_failures and the step's integrity "
+                     "(python -m tools.fmckpt verify)"] + notes)}
     if unclosed:
         return {"verdict": "CRASHED", "detail": notes[0]}
     if fallbacks:
@@ -480,6 +513,23 @@ def stale_publish(summary: Dict[str, Any]
 # Publish-freshness ceiling, in intervals: past this the health verdict
 # flips to STALE PUBLISH (the serving fleet is reloading old state).
 STALE_PUBLISH_MULTIPLE = 3.0
+
+
+def stale_model(summary: Dict[str, Any]
+                ) -> Optional[Tuple[float, float]]:
+    """(served step, published step) when a serving stream's last
+    flush shows the served checkpoint LAGGING the published pointer —
+    the reload loop failed to land the new step — else None. Only
+    meaningful for serve streams (both gauges present); a healthy
+    server's final flush always has served == published."""
+    g = summary.get("gauges", {})
+    served = g.get("serve/served_step")
+    published = g.get("serve/published_step")
+    if served is None or published is None:
+        return None
+    if published > served:
+        return float(served), float(published)
+    return None
 
 
 def dedup_hit_rate(counters: Dict[str, float]) -> Optional[float]:
@@ -628,6 +678,30 @@ def render(summary: Dict[str, Any]) -> str:
                  f"{_fmt(age)} / {_fmt(interval)}"),
         ):
             lines.append(f"    {k:<32} {v}")
+    if att["serve_requests"] or att["serve_served_step"] is not None:
+        lines.append("  SERVING (run_tffm.py serve):")
+        for k, v in (
+                ("requests / examples",
+                 f"{_fmt(att['serve_requests'])} / "
+                 f"{_fmt(att['serve_examples'])}"),
+                ("request latency p50 / p99 (ms)",
+                 f"{_fmt(att['serve_latency_p50_ms'])} / "
+                 f"{_fmt(att['serve_latency_p99_ms'])}"),
+                ("micro-batch flushes (errors)",
+                 f"{_fmt(att['serve_flushes'])} "
+                 f"({_fmt(att['serve_flush_errors'])})"),
+                ("padded examples (ladder waste)",
+                 att["serve_padded_examples"]),
+                ("queue depth p90",
+                 att["serve_queue_depth_p90"]),
+                ("hot reloads (failed)",
+                 f"{_fmt(att['serve_reloads'])} "
+                 f"({_fmt(att['serve_reload_failures'])})"),
+                ("served / published step",
+                 f"{_fmt(att['serve_served_step'])} / "
+                 f"{_fmt(att['serve_published_step'])}"),
+        ):
+            lines.append(f"    {k:<32} {_fmt(v)}")
     worker_rows = worker_table(summary)
     if worker_rows:
         lines.append("  workers (per-process liveness):")

@@ -34,8 +34,13 @@ from fast_tffm_tpu.scoring import ScoreWriter, score_sweep
 from fast_tffm_tpu.utils.logging import get_logger
 
 
-def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
-    """Restore the table from the latest checkpoint.
+def load_table(cfg: FmConfig, mesh=None,
+               step: Optional[int] = None) -> jax.Array:
+    """Restore the table from the latest checkpoint — or, with an
+    explicit ``step``, those exact verified bytes (the serving
+    process's hot-reload load, and the soak's per-step parity control;
+    restore() verifies an explicit step and raises instead of walking
+    past it).
 
     With a mesh: restored ROW-SHARDED in the [ckpt_rows, D] checkpoint
     layout — the full table never materializes on one device or host
@@ -47,7 +52,8 @@ def load_table(cfg: FmConfig, mesh=None) -> jax.Array:
     ckpt = CheckpointState(cfg.model_file,
                            retry=RetryPolicy.from_config(cfg),
                            verify=getattr(cfg, "ckpt_verify", "size"))
-    restored = ckpt.restore(template=checkpoint_template(cfg, mesh))
+    restored = ckpt.restore(step=step,
+                            template=checkpoint_template(cfg, mesh))
     ckpt.close()
     if restored is None:
         raise FileNotFoundError(

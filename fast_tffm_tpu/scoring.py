@@ -49,6 +49,54 @@ from fast_tffm_tpu.utils.fetch import ChunkedFetcher
 DEPTH_BUCKETS = tuple(2 ** i for i in range(11))
 
 
+class CompiledScorer:
+    """The long-lived compiled-scorer handle both inference surfaces
+    share: batch predict's cross-file sweep (score_sweep below) and the
+    online serving process (serve/server.py). Wraps the one dispatch
+    over the three inference paths (models/fm.make_batch_scorer), the
+    raw-batch policy (ships_raw_batches — the pipeline must build
+    batches in the shape the compiled program expects, and a drifted
+    copy of that condition is how a raw-gather scorer ends up fed
+    host-deduped batches), and the spec resolution, so a caller can't
+    pair a scorer with the wrong batch builder.
+
+    ``dedup`` overrides the config's resolution — the serving process
+    forces ``"device"`` (the raw-gather path: no U axis, so its
+    pre-compiled shape ladder is exactly [B rung, L rung] and every
+    padded request shape is known at warmup). jit executables are
+    cached per (spec, shape) process-wide (models/fm lru caches), so a
+    handle is cheap to construct and compiled code outlives it."""
+
+    def __init__(self, cfg: FmConfig, mesh=None, backend=None,
+                 dedup: Optional[str] = None):
+        import dataclasses
+        from fast_tffm_tpu.models.fm import (ModelSpec,
+                                             make_batch_scorer,
+                                             ships_raw_batches)
+        spec = ModelSpec.from_config(cfg)
+        if dedup is not None:
+            spec = dataclasses.replace(spec, dedup=dedup)
+        self.spec = spec
+        self.mesh = mesh
+        self.backend = backend
+        # Whether batch builders must ship RAW ids ([B, L], uniq_ids
+        # None) for this scorer — threaded into batch_iterator /
+        # make_device_batch by every caller.
+        self.raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
+        self._score = make_batch_scorer(spec, mesh=mesh, backend=backend)
+
+    def score_batch(self, table, batch) -> "object":
+        """Raw [B] scores (device-resident) for one DeviceBatch —
+        labels/weights dropped here so callers can't accidentally ship
+        them. Deliberately does not materialize to numpy (see
+        make_batch_scorer: a per-batch fetch collapses async
+        dispatch)."""
+        from fast_tffm_tpu.models.fm import batch_args
+        args = batch_args(batch)
+        args.pop("labels"), args.pop("weights")
+        return self._score(table, args)
+
+
 class ScoreWriter:
     """Ordered score-file writer on a small background thread, so the
     next file's parse/score/D2H overlaps the previous file's disk
@@ -240,13 +288,8 @@ def score_sweep(cfg: FmConfig, table, files: Sequence[str],
     per-file fetcher drain: the compiled scorer and the D2H overlap
     worker live across every boundary, which is where the 15x
     predict-vs-train gap lived (BENCH_r05, ISSUE 10)."""
-    from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
-                                         make_batch_scorer,
-                                         ships_raw_batches)
     files = list(files)  # consumed twice (span field + iterator)
-    spec = ModelSpec.from_config(cfg)
-    score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
-    raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
+    scorer = CompiledScorer(cfg, mesh=mesh, backend=backend)
     marks = FileMarks()
     demux = ScoreDemux(marks, on_file)
     fetcher = ChunkedFetcher(
@@ -260,14 +303,13 @@ def score_sweep(cfg: FmConfig, table, files: Sequence[str],
     try:
         with span("predict/sweep", files=len(files)):
             it = batch_iterator(cfg, files, training=False, epochs=1,
-                                keep_empty=True, raw_ids=raw,
+                                keep_empty=True, raw_ids=scorer.raw,
                                 file_marks=marks)
             for batch in prefetch(it, depth=cfg.prefetch_depth,
                                   gil_bound=gil_bound_iteration(
                                       cfg, keep_empty=True)):
-                args = batch_args(batch)
-                args.pop("labels"), args.pop("weights")
-                fetcher.add(score_fn(table, args), batch.num_real)
+                fetcher.add(scorer.score_batch(table, batch),
+                            batch.num_real)
                 n_examples += batch.num_real
                 if tel is not None:
                     tel.count("predict/batches")

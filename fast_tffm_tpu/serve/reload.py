@@ -1,0 +1,74 @@
+"""Hot-reload watcher: poll the ``published`` pointer, swap the table.
+
+The pointer file in ``<model_file>.ckpt/`` is the train->serve
+contract (PR 8): the stream driver only repoints it at a
+manifest-verified step, atomically. This thread is the serve side of
+that contract — re-read the pointer every ``serve_poll_seconds``, and
+when it names a step other than the one being served, restore it
+through the same verified-restore path (an explicit step is verified,
+never walked past) and hand it to the server's atomic swap. Requests
+in flight keep the table reference their flush captured: the old table
+is retained until the last batch referencing it drains — no torn
+scores, and every response says which step scored it.
+
+Failure posture: a garbled/unreadable pointer reads as "nothing new"
+and heals on the next poll (read_published's contract); a step that
+fails verification or restore counts a ``serve/reload_failures`` and
+the PREVIOUS table keeps serving — a bad publish must degrade to
+staleness (visible as fmstat's STALE MODEL), never to an outage.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from fast_tffm_tpu.checkpoint import read_published
+
+
+class ReloadWatcher:
+    """Daemon poll thread (``fm-serve-reload``). ``poll_once`` is the
+    whole per-tick protocol, public so unit tests can drive it without
+    the thread."""
+
+    def __init__(self, server, poll_seconds: float):
+        self._server = server
+        self._poll = float(poll_seconds)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="fm-serve-reload",
+                                        daemon=True)
+
+    def start(self) -> "ReloadWatcher":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - the poll loop must
+                # survive anything: a transient filesystem error on one
+                # tick is the next tick's clean read. Real reload
+                # failures are already counted inside reload_step.
+                self._server._logger.exception(
+                    "published-pointer poll failed; retrying next tick")
+
+    def poll_once(self) -> bool:
+        """One tick: read the pointer, record what it says (the
+        published-step gauge), reload when it moved. Returns True when
+        a reload was attempted."""
+        # A live poll IS liveness: without this, a traffic-idle server
+        # under a configured stall watchdog reads as STALLED.
+        self._server.idle_beat()
+        step = read_published(self._server.directory)
+        if step is None:
+            return False
+        self._server.note_published(step)
+        if step == self._server.served_step:
+            return False
+        self._server.reload_step(step)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join()

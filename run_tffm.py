@@ -6,6 +6,7 @@
     python run_tffm.py train   <cfg> dist_train <job_name> <task_index>
     python run_tffm.py predict <cfg>
     python run_tffm.py predict <cfg> dist_train <job_name> <task_index>
+    python run_tffm.py serve   <cfg>
 
 ``dist_train`` roles map onto synchronous jax.distributed processes
 instead of TF1 ps/worker async-SGD (SURVEY §7): ``worker i`` becomes DP
@@ -14,6 +15,12 @@ message, since parameter serving is subsumed by the row-sharded table.
 ``predict ... dist_train`` (an extension: the reference predicts
 single-process) shards the predict input across the same worker
 cluster and merges ordered score files on the chief.
+
+``serve`` (an extension; README "Serving") runs the long-lived online
+scorer: it loads the ``published`` checkpoint step, micro-batches
+concurrent requests behind a stdlib HTTP front end (POST /score, GET
+/healthz on ``serve_port``), and hot-reloads when the pointer moves.
+SIGTERM/SIGINT drain and exit cleanly.
 """
 
 from __future__ import annotations
@@ -59,7 +66,7 @@ def _usage() -> int:
 
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if len(argv) < 2 or argv[0] not in ("train", "predict"):
+    if len(argv) < 2 or argv[0] not in ("train", "predict", "serve"):
         return _usage()
     mode, cfg_path = argv[0], argv[1]
     rest = argv[2:]
@@ -86,6 +93,15 @@ def main(argv=None) -> int:
         import dataclasses
         cfg = dataclasses.replace(
             cfg, watchdog_stall_seconds=float(stall_override))
+
+    if mode == "serve":
+        if rest:
+            print("serve takes no dist_train role: the scorer is "
+                  "single-process (run one per host behind a load "
+                  "balancer)", file=sys.stderr)
+            return _usage()
+        from fast_tffm_tpu.serve.frontend import run_serve
+        return run_serve(cfg)
 
     job_name = task_index = None
     if rest:

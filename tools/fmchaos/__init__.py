@@ -31,6 +31,16 @@ asserts the documented recovery behavior:
                       exactly once naming the worst file — with no
                       ``fm-build`` worker threads leaked after the
                       abort.
+- ``serve-soak``      the online serving subsystem under concurrency
+                      and a hot reload: 4 client threads fire
+                      variable-size requests at a live ScorerServer
+                      while `fmckpt publish` repoints the pointer →
+                      responses land on BOTH steps, every one
+                      bit-identical to batch predict against the step
+                      that scored it, fmstat's SERVING section shows
+                      the p50/p99 latency histograms with served ==
+                      published at close, and no fm-serve thread
+                      survives close().
 - ``preempt-resume``  SIGTERM mid-epoch → the run saves and exits
                       cleanly, ``fmstat`` reports PREEMPTED (not
                       CRASHED); a restart resumes the interrupted
@@ -576,6 +586,145 @@ def scenario_predict_flaky(workdir: str, seed: int = 0) -> str:
             "leaks")
 
 
+def scenario_serve_soak(workdir: str, seed: int = 0) -> str:
+    """ISSUE 11 acceptance: a long-lived scorer process serving
+    CONCURRENT requests across at least one hot reload. Every response
+    must be bit-identical to batch predict against the checkpoint step
+    that scored it (responses are step-tagged), the reload is driven
+    through the real pointer-watch loop by the `fmckpt publish`
+    operator path, fmstat's SERVING section shows the p50/p99 latency
+    histograms with no STALE MODEL, and no server/reload thread
+    survives close()."""
+    import dataclasses as dc
+    import threading
+    import time as _time
+    from fast_tffm_tpu.checkpoint import (CheckpointState,
+                                          list_step_dirs)
+    from fast_tffm_tpu.metrics import sigmoid
+    from fast_tffm_tpu.predict import load_table, predict_scores
+    from fast_tffm_tpu.serve import ScoreClient, ScorerServer
+    from fast_tffm_tpu.train import train
+    from tools.fmckpt import cmd_publish
+
+    data = os.path.join(workdir, "train.txt")
+    _write_corpus(data, 400, seed)
+    cfg = _cfg(workdir, data, epoch_num=2, save_steps=5,
+               bucket_ladder=(8, 16), max_features_per_example=16,
+               serve_max_batch=8, serve_max_wait_ms=2.0,
+               serve_poll_seconds=0.02,
+               metrics_file=os.path.join(workdir,
+                                         "serve_metrics.jsonl"))
+    train(dc.replace(cfg, metrics_file=""))
+    ckpt = CheckpointState(cfg.model_file)
+    steps = list_step_dirs(ckpt.directory)
+    ckpt.close()
+    assert len(steps) >= 2, f"need >= 2 retained steps, got {steps}"
+    s_old, s_new = steps[0], steps[-1]
+    # First publish through the operator CLI — the same path the
+    # mid-soak repoint uses, so both flips exercise fmckpt publish.
+    assert cmd_publish(cfg.model_file + ".ckpt", s_old) == 0
+
+    server = ScorerServer(cfg)
+    client = ScoreClient(server)
+    req_lines = _corpus_lines(60, seed + 99)
+    results = []   # (request lines, scores, step) — appended under lock
+    res_lock = threading.Lock()
+    errors = []
+    stop_firing = threading.Event()
+
+    def fire(worker: int) -> None:
+        rng = np.random.default_rng(seed + worker)
+        while not stop_firing.is_set():
+            k = int(rng.integers(1, 6))
+            lo = int(rng.integers(0, len(req_lines) - k))
+            lines = req_lines[lo:lo + k]
+            try:
+                res = client.score(lines, timeout=30)
+            except Exception as e:  # noqa: BLE001 - assert at the end
+                errors.append(e)
+                return
+            with res_lock:
+                results.append((lines, res.scores, res.step))
+
+    threads = [threading.Thread(target=fire, args=(i,),
+                                name=f"soak-client-{i}")
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    # Let requests land on the OLD step, flip the pointer through the
+    # operator CLI mid-fire, then keep firing until requests are
+    # provably landing on the NEW step.
+    deadline = _time.monotonic() + 30
+    while not any(r[2] == s_old for r in list(results)):
+        assert _time.monotonic() < deadline, "no old-step responses"
+        _time.sleep(0.01)
+    assert cmd_publish(cfg.model_file + ".ckpt", s_new) == 0
+    while not any(r[2] == s_new for r in list(results)):
+        assert _time.monotonic() < deadline, (
+            f"hot reload to step {s_new} never served a request "
+            f"(errors: {errors[:1]})")
+        _time.sleep(0.01)
+    stop_firing.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    server.close()
+
+    leaked = [t.name for t in threading.enumerate()
+              if t.is_alive() and t.name.startswith("fm-serve")]
+    assert not leaked, f"leaked server threads: {leaked}"
+
+    by_step = {}
+    for _lines, _scores, step in results:
+        by_step.setdefault(step, []).append((_lines, _scores))
+    assert set(by_step) == {s_old, s_new}, (
+        f"responses span steps {sorted(by_step)}, wanted "
+        f"{[s_old, s_new]}")
+    # Bit-identical parity per step: batch predict over the SAME lines
+    # against the same published checkpoint must reproduce every
+    # response byte for byte (the step tag says which table scored it).
+    pcfg = dc.replace(cfg, metrics_file="")
+    for step, pairs in sorted(by_step.items()):
+        table = load_table(pcfg, step=step)
+        req_path = os.path.join(workdir, f"requests_{step}.txt")
+        flat, sizes = [], []
+        for lines, _scores in pairs:
+            flat.extend(lines)
+            sizes.append(len(lines))
+        with open(req_path, "w") as fh:
+            fh.write("\n".join(flat) + "\n")
+        want = sigmoid(predict_scores(pcfg, table, [req_path]))
+        pos = 0
+        for (lines, scores), n in zip(pairs, sizes):
+            ref = want[pos:pos + n]
+            pos += n
+            assert np.array_equal(ref, scores), (
+                f"step {step}: served scores diverged from batch "
+                f"predict on the same checkpoint ({scores[:3]} vs "
+                f"{ref[:3]})")
+    # fmstat SERVING section: latency histograms visible, reload
+    # counted, and the final flush shows served == published (no
+    # STALE MODEL).
+    from fast_tffm_tpu.obs.attribution import attribution, render
+    summ = _summary(cfg)
+    att = attribution(summ)
+    assert att["serve_requests"] == len(results), (
+        att["serve_requests"], len(results))
+    assert att["serve_latency_p50_ms"] is not None
+    assert att["serve_latency_p99_ms"] is not None
+    assert att["serve_reloads"] >= 1
+    assert att["serve_served_step"] == s_new
+    text = render(summ)
+    assert "SERVING" in text and "request latency p50 / p99" in text
+    assert _verdict(cfg) == "OK", _verdict(cfg)
+    n_old, n_new = len(by_step[s_old]), len(by_step[s_new])
+    return (f"{len(results)} concurrent requests ({n_old} on step "
+            f"{s_old}, {n_new} on step {s_new} after the hot reload) "
+            f"all bit-identical to batch predict; p50="
+            f"{att['serve_latency_p50_ms']:.1f}ms p99="
+            f"{att['serve_latency_p99_ms']:.1f}ms, no thread leaks")
+
+
 # --- streaming run-mode scenarios ----------------------------------------
 
 
@@ -1057,6 +1206,7 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "flaky-open": scenario_flaky_open,
     "flaky-open-parallel": scenario_flaky_open_parallel,
     "predict-flaky": scenario_predict_flaky,
+    "serve-soak": scenario_serve_soak,
     "preempt-resume": scenario_preempt_resume,
     "stream-soak": scenario_stream_soak,
     "stream-truncate": scenario_stream_truncate,

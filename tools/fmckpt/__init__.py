@@ -3,6 +3,7 @@ directory (README "Checkpoint integrity & fallback").
 
     python -m tools.fmckpt ls <model_file | dir.ckpt>
     python -m tools.fmckpt verify <path> [--mode size|full] [--step N]
+    python -m tools.fmckpt publish <path> <step> [--mode size|full]
     python -m tools.fmckpt gc <path> [--dry-run]
 
 The offline view of the invariants ``fast_tffm_tpu/checkpoint.py``
@@ -20,6 +21,15 @@ enforces at run time:
               report UNVERIFIABLE, not FAIL. Exit 1 on any failure.
               Read-only: unlike restore, the tool never quarantines —
               the operator decides.
+- ``publish`` verify a committed step, then atomically repoint the
+              ``published`` pointer file at it — the manual operator
+              path onto the same verify-then-repoint sequence the
+              stream trainer's publish loop runs, and the signal a
+              serving process's hot-reload poll watches (README
+              "Serving"). A step that is missing or fails verification
+              leaves the pointer untouched (exit 1): the pointer must
+              only ever name verified bytes. ``ls`` shows the result
+              as the PUBLISHED mark.
 - ``gc``      reclaim space: delete quarantined ``corrupt-*`` dirs and
               orphaned ``manifest-*``/``epoch_override-*`` sidecars
               whose step no longer exists. This is the ONE sanctioned
@@ -203,6 +213,36 @@ def cmd_verify(directory: str, mode: str = "full",
     return 1 if failures else 0
 
 
+def cmd_publish(directory: str, step: int, mode: str = "size",
+                out=None) -> int:
+    """Verify-then-repoint (the operator half of the publish
+    contract): the pointer moves ONLY when the step exists and passes
+    the manifest check at ``mode`` — the same gate
+    ``CheckpointState.publish_step`` applies, via the same shared
+    ``write_published`` atomic-rename write, so a serving process's
+    concurrent reload poll can never read a torn or unverified
+    value."""
+    import sys
+    out = out or sys.stdout
+    committed = list_step_dirs(directory)
+    if step not in committed:
+        out.write(f"step {step}: MISSING — not a committed step "
+                  f"(committed: {committed or 'none'}); pointer "
+                  "untouched\n")
+        return 1
+    reason = verify_step_dir(directory, step, mode)
+    if reason is not None:
+        out.write(f"step {step}: FAIL — {reason}; pointer untouched\n")
+        return 1
+    prev = read_published(directory)
+    from fast_tffm_tpu.checkpoint import write_published
+    path = write_published(directory, step)
+    frm = f"step {prev} -> " if prev is not None else ""
+    out.write(f"published {frm}step {step} ({mode}-verified) -> "
+              f"{path}\n")
+    return 0
+
+
 def cmd_gc(directory: str, dry_run: bool = False, out=None) -> int:
     import shutil
     import sys
@@ -252,6 +292,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_v.add_argument("path")
     p_v.add_argument("--mode", choices=("size", "full"), default="full")
     p_v.add_argument("--step", type=int, default=None)
+    p_pub = sub.add_parser(
+        "publish", help="verify a step, then atomically repoint the "
+                        "published pointer at it")
+    p_pub.add_argument("path")
+    p_pub.add_argument("step", type=int)
+    p_pub.add_argument("--mode", choices=("size", "full"),
+                       default="size")
     p_gc = sub.add_parser("gc", help="delete quarantined dirs + orphans")
     p_gc.add_argument("path")
     p_gc.add_argument("--dry-run", action="store_true")
@@ -265,4 +312,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         return cmd_ls(directory, as_json=args.json)
     if args.cmd == "verify":
         return cmd_verify(directory, mode=args.mode, step=args.step)
+    if args.cmd == "publish":
+        return cmd_publish(directory, args.step, mode=args.mode)
     return cmd_gc(directory, dry_run=args.dry_run)

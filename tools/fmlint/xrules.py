@@ -264,7 +264,7 @@ def r008_unsynchronized_shared_mutation(proj: Project) -> List[Finding]:
 # --- R009: config/knob drift ----------------------------------------------
 
 _SECTION_BY_DICT = {"_GENERAL_KEYS": "General", "_TRAIN_KEYS": "Train",
-                    "_PREDICT_KEYS": "Predict",
+                    "_PREDICT_KEYS": "Predict", "_SERVE_KEYS": "Serve",
                     "_CLUSTER_KEYS": "Cluster"}
 
 
