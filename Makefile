@@ -10,7 +10,9 @@
 #                   parallel data plane (no device needed)
 #   make bench-predict  standalone predict line: cross-file streaming
 #                   scorer trials + its host_threads 1/2/4 sweep
-#   make lint       fmlint whole-program pass (R000-R010) over
+#   make bench-vocab    admission-path overhead: train e2e at
+#                   vocab_mode=admit vs fixed (target <= 5% cost)
+#   make lint       fmlint whole-program pass (R000-R011) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
 #   make stream-soak  the streaming run-mode scenarios standalone
@@ -46,6 +48,9 @@ bench-host: $(SO)
 bench-predict: $(SO)
 	python bench.py --predict
 
+bench-vocab: $(SO)
+	python bench.py --vocab
+
 lint:
 	python -m tools.fmlint
 
@@ -64,4 +69,4 @@ serve-soak: $(SO)
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict lint chaos stream-soak serve serve-soak clean
+.PHONY: all test bench bench-host bench-predict bench-vocab lint chaos stream-soak serve serve-soak clean

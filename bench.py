@@ -131,12 +131,14 @@ def _raw_mode(cfg):
     return ModelSpec.from_config(cfg).dedup == "device"
 
 
-def run_e2e(cfg, step, n_warm=N_WARM):
+def run_e2e(cfg, step, n_warm=N_WARM, vocab=None):
     """One honest end-to-end trial: file -> C++ parse -> build -> H2D ->
     jitted step, host pipeline prefetching ahead of the device (the same
     loop train() runs; dedup runs host- or device-side per the resolved
     spec, like train() does). One timing protocol for every e2e line
-    (FM headline and FFM)."""
+    (FM headline and FFM). ``vocab`` (the --vocab line): the admission
+    runtime, exercised exactly as train() does — remap in the pipeline,
+    note_trained per stepped batch."""
     import jax
     from fast_tffm_tpu.data.pipeline import (batch_iterator,
                                              gil_bound_iteration, prefetch)
@@ -145,7 +147,7 @@ def run_e2e(cfg, step, n_warm=N_WARM):
     table = init_table(cfg, 0)
     acc = init_accumulator(cfg)
     it = prefetch(batch_iterator(cfg, cfg.train_files, training=True,
-                                 raw_ids=_raw_mode(cfg)),
+                                 raw_ids=_raw_mode(cfg), vocab=vocab),
                   depth=4, gil_bound=gil_bound_iteration(cfg))
     t0 = None
     n = 0
@@ -153,6 +155,8 @@ def run_e2e(cfg, step, n_warm=N_WARM):
     # its actual rows, not batch_size)
     for batch in it:
         table, acc, loss, _ = step(table, acc, **batch_args(batch))
+        if vocab is not None:
+            vocab.note_trained(batch)
         n += 1
         if t0 is not None:
             n_real += batch.num_real
@@ -863,6 +867,63 @@ def serve_latency_main():
     }))
 
 
+def vocab_overhead_main():
+    """Standalone admission-path overhead line (`python bench.py
+    --vocab` / `make bench-vocab`): train e2e examples/sec at
+    ``vocab_mode = admit`` vs ``fixed`` on the same hashed-id corpus —
+    the admit run pays the per-batch remap (binary-search over the
+    frozen slot map + host re-dedup) and the per-step sketch
+    observation, against a map POPULATED by a real warmup pass + one
+    barrier (the steady state between barriers, which is what a long
+    stream runs in). Target: ratio >= 0.95 (<= 5% regression). One
+    JSON line."""
+    import dataclasses
+    import tempfile
+    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
+    from fast_tffm_tpu.vocab.table import VocabRuntime
+    _enable_compile_cache()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "train.txt")
+        lines = synth_lines((N_WARM + N_TIMED) * B, 1 << 20)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        del lines
+        base = dataclasses.replace(make_cfg(path), hash_feature_id=True,
+                                   vocabulary_size=1 << 17)
+        admit_cfg = dataclasses.replace(
+            base, vocab_mode="admit", vocab_admit_threshold=2.0,
+            vocab_decay=0.5, vocab_sketch_mb=1.0)
+        fixed_step = make_train_step(ModelSpec.from_config(base))
+        fixed = [run_e2e(base, fixed_step) for _ in range(TRIALS)]
+        vocab = VocabRuntime.from_config(admit_cfg)
+        # Populate the slot map the way a running stream would: one
+        # untimed observation pass + a barrier, so the timed trials
+        # remap through a realistic frozen map instead of an empty one
+        # (all-cold lookups would understate the binary-search cost).
+        from fast_tffm_tpu.data.pipeline import batch_iterator
+        for batch in batch_iterator(admit_cfg, admit_cfg.train_files,
+                                    training=True,
+                                    raw_ids=_raw_mode(admit_cfg),
+                                    vocab=vocab):
+            vocab.note_trained(batch)
+        vocab.barrier(None)
+        admit_step = make_train_step(ModelSpec.from_config(admit_cfg))
+        admit = [run_e2e(admit_cfg, admit_step, vocab=vocab)
+                 for _ in range(TRIALS)]
+    f_med = statistics.median(fixed)
+    a_med = statistics.median(admit)
+    print(json.dumps({
+        "metric": "vocab_admit_vs_fixed_ratio",
+        "value": round(a_med / f_med, 3) if f_med else None,
+        "unit": "admit/fixed train examples/sec (target >= 0.95)",
+        "vocab_fixed_eps": round(f_med, 1),
+        "vocab_admit_eps": round(a_med, 1),
+        "vocab_fixed_trials": [round(v, 1) for v in fixed],
+        "vocab_admit_trials": [round(v, 1) for v in admit],
+        "vocab_live_rows": vocab.live_rows,
+    }))
+
+
 def predict_sweep_main():
     """Standalone predict line (`make bench-predict` / `python bench.py
     --predict`): TRIALS full sweeps of the cross-file streaming scorer
@@ -902,6 +963,8 @@ if __name__ == "__main__":
         host_sweep_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--predict":
         predict_sweep_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--vocab":
+        vocab_overhead_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
         serve_latency_main()
     else:

@@ -62,7 +62,8 @@ _HASH_CHUNK_BYTES = 1 << 20
 # litter).
 SIDECAR_RE = re.compile(
     r"(?:epoch_override-(\d+)|manifest-(\d+)\.json(?:\.tmp)?"
-    r"|watermark-(\d+)\.json(?:\.tmp)?)")
+    r"|watermark-(\d+)\.json(?:\.tmp)?"
+    r"|vocab-(\d+)\.json\.gz(?:\.tmp)?)")
 
 # Stream-mode publish pointer (README "Streaming / online learning"):
 # a tiny file in the .ckpt directory naming the newest PUBLISHED step —
@@ -77,7 +78,7 @@ def sidecar_step(name: str) -> Optional[int]:
     m = SIDECAR_RE.fullmatch(name)
     if not m:
         return None
-    return int(m.group(1) or m.group(2) or m.group(3))
+    return int(m.group(1) or m.group(2) or m.group(3) or m.group(4))
 
 
 def manifest_path(directory: str, step: int) -> str:
@@ -96,13 +97,13 @@ def read_epoch_override(directory: str, step: int) -> Optional[int]:
         return None
 
 
-def _atomic_write_text(path: str, data: str) -> None:
+def _atomic_write_bytes(path: str, blob: bytes) -> None:
     """The ONE tmp-write + fsync + rename sequence every sidecar
-    writer (manifest, epoch override, watermark, published pointer)
-    shares: the file either exists complete or not at all, and a
-    failed write never litters its .tmp (a hard kill still can — the
-    SIDECAR_RE orphan scans sweep those). Deliberately unretried:
-    save-side write failures must surface at the save site
+    writer (manifest, epoch override, watermark, vocab sidecar,
+    published pointer) shares: the file either exists complete or not
+    at all, and a failed write never litters its .tmp (a hard kill
+    still can — the SIDECAR_RE orphan scans sweep those). Deliberately
+    unretried: save-side write failures must surface at the save site
     (CheckpointState docstring)."""
     tmp = path + ".tmp"
     try:
@@ -110,8 +111,8 @@ def _atomic_write_text(path: str, data: str) -> None:
         # never retried (CheckpointState docstring): a failed sidecar
         # write must fail its save loudly, not mask a torn file
         # behind backoff
-        with open(tmp, "w", encoding="utf-8") as fh:
-            fh.write(data)
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
             fh.flush()
             os.fsync(fh.fileno())
         os.replace(tmp, path)
@@ -121,6 +122,10 @@ def _atomic_write_text(path: str, data: str) -> None:
         except OSError:
             pass
         raise
+
+
+def _atomic_write_text(path: str, data: str) -> None:
+    _atomic_write_bytes(path, data.encode("utf-8"))
 
 
 def watermark_path(directory: str, step: int) -> str:
@@ -158,6 +163,117 @@ def write_watermark(directory: str, step: int, payload: dict) -> str:
     a torn watermark must never resume a stream at a garbage offset."""
     path = watermark_path(directory, step)
     _atomic_write_text(path, json.dumps(payload, sort_keys=True))
+    return path
+
+
+def vocab_sidecar_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"vocab-{step}.json.gz")
+
+
+def load_vocab_sidecar(directory: str, step: int
+                       ) -> Tuple[Optional[dict], Optional[str]]:
+    """(payload, reason) for a step's vocab-admission sidecar: the
+    ONE torn-sidecar decision shared by the restore path and `fmckpt
+    verify` so the two can never disagree on what a torn sidecar is.
+    Absent -> (None, None); readable with a matching embedded crc32 ->
+    (payload, None); unreadable gzip/json or a crc mismatch ->
+    (None, <human-readable failure>)."""
+    import gzip
+    path = vocab_sidecar_path(directory, step)
+    name = os.path.basename(path)
+    try:
+        # fmlint: disable=R010 -- missing IS the common case (every
+        # fixed-mode checkpoint); a garbled sidecar must become the
+        # same "no admission state" verdict the caller handles, not a
+        # retry loop inside the restore decision
+        with gzip.open(path, "rt", encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except FileNotFoundError:
+        return None, None
+    except (ValueError, OSError, EOFError) as e:
+        return None, f"vocab sidecar {name} is unreadable/garbled: {e}"
+    from fast_tffm_tpu.vocab.table import payload_crc_ok
+    if not payload_crc_ok(payload):
+        return None, (f"vocab sidecar {name} failed its embedded "
+                      "crc32 check (torn or bit-rotted)")
+    return payload, None
+
+
+def load_vocab_map(cfg, directory: str, step: Optional[int]):
+    """The ONE inference-side (table, slot map, step) pairing load —
+    predict and the serving reload both route here so the triple
+    contract can't drift between them. Returns the step's VocabMap;
+    raises FileNotFoundError when the step carries no readable sidecar
+    (missing OR torn — scoring without the slot map would misroute
+    every admitted id)."""
+    payload = (read_vocab_sidecar(directory, int(step))
+               if step is not None and step >= 0 else None)
+    if payload is None:
+        raise FileNotFoundError(
+            f"checkpoint step {step} at {directory} carries no "
+            "readable vocab admission sidecar (vocab-<step>.json.gz) "
+            "but vocab_mode = admit: scoring without the slot map "
+            "would misroute every admitted id. Was the model trained "
+            "with vocab_mode = fixed?")
+    from fast_tffm_tpu.vocab.table import VocabMap
+    return VocabMap.from_payload(cfg, payload)
+
+
+def refuse_fixed_mode_admit_step(cfg, directory: str,
+                                 step: Optional[int],
+                                 payload: Optional[dict] = None
+                                 ) -> None:
+    """The ONE admit-trained-under-fixed loud failure (train resume,
+    predict, serve reload all call it): a step carrying a vocab
+    admission sidecar was trained with ``vocab_mode = admit`` — its
+    table rows are slot-mapped — so loading it under ``fixed`` would
+    gather/train arbitrary rows with zero errors. Keys on sidecar
+    EXISTENCE, not readability: a TORN sidecar still proves admit
+    training. ``payload``: a sidecar payload the caller already read
+    (the restore overlay), counted as the same evidence. No-op under
+    admit mode or when ``step`` is unknown."""
+    if getattr(cfg, "vocab_mode", "fixed") != "fixed":
+        return
+    if payload is None and (step is None or step < 0
+                            or not os.path.exists(
+                                vocab_sidecar_path(directory,
+                                                   int(step)))):
+        return
+    raise ValueError(
+        f"checkpoint step {step} carries a vocab admission sidecar — "
+        "it was trained with vocab_mode = admit, so its table rows "
+        "are slot-mapped — but this config has vocab_mode = fixed: "
+        "modulo ids would gather/train the wrong rows. Set "
+        "vocab_mode = admit (or start a fresh model_file).")
+
+
+def read_vocab_sidecar(directory: str, step: int) -> Optional[dict]:
+    """The step's vocab-admission sidecar payload (vocab_mode = admit;
+    vocab/table.py), or None when the step has none (every fixed-mode
+    checkpoint). A garbled/torn sidecar returns None WITH a warning:
+    train() then refuses to silently continue with a scrambled slot
+    map (its restore path treats a missing payload on an admit-mode
+    resume as a loud fresh-admission-plus-row-reset fallback)."""
+    payload, reason = load_vocab_sidecar(directory, step)
+    if reason is not None:
+        get_logger().warning(
+            "%s; treating step %d as carrying no admission state",
+            reason, step)
+    return payload
+
+
+def write_vocab_sidecar(directory: str, step: int,
+                        payload: dict) -> str:
+    """Atomically-renamed gzip write of the vocab admission payload
+    (same tmp+fsync+rename contract as every other sidecar): it either
+    exists complete or not at all — a torn slot map must never remap a
+    resumed stream onto garbage rows. The payload carries its own
+    crc32 (vocab/table.py), which read_vocab_sidecar and `fmckpt
+    verify` both re-check."""
+    import gzip
+    path = vocab_sidecar_path(directory, step)
+    _atomic_write_bytes(path, gzip.compress(
+        json.dumps(payload, sort_keys=True).encode("utf-8")))
     return path
 
 
@@ -399,7 +515,8 @@ class CheckpointState:
              vocabulary_size: int, force: bool = False,
              wait: bool = False, epoch: int = 0,
              rewrite_stale_metadata: bool = False,
-             stream_state: Optional[dict] = None) -> None:
+             stream_state: Optional[dict] = None,
+             vocab_state: Optional[dict] = None) -> None:
         """``vocabulary_size`` is stored alongside the arrays: the
         4096-aligned row layout means a changed vocab inside the same
         bucket would otherwise restore shape-compatibly but silently
@@ -497,6 +614,18 @@ class CheckpointState:
             # only advances with global steps).
             if stream_state is not None and jax.process_index() == 0:
                 write_watermark(self.directory, int(step), stream_state)
+            # Vocab-admission sidecar (vocab_mode = admit): pairs with
+            # the step exactly like the watermark — written after the
+            # fresh-step prune, on both the fresh-save and same-step-
+            # collision paths. The collision path's payload IS
+            # identical to the colliding save's: the slot map only
+            # moves at barriers, and every barrier-adjacent save
+            # (publish, final) passes force=True precisely so a
+            # post-barrier sidecar is never paired with skipped
+            # pre-barrier arrays.
+            if vocab_state is not None and jax.process_index() == 0:
+                write_vocab_sidecar(self.directory, int(step),
+                                    vocab_state)
             if wait:
                 self._mngr.wait_until_finished()
                 self._flush_pending_manifest()
@@ -567,13 +696,17 @@ class CheckpointState:
         if fresh_step is not None:
             mp = manifest_path(self.directory, fresh_step)
             wp = watermark_path(self.directory, fresh_step)
+            vp = vocab_sidecar_path(self.directory, fresh_step)
             # The watermark is correctness-bearing like the epoch
             # sidecar: a surviving stale one (cleared-and-reused dir,
             # or an epoch-mode save landing on an old stream step)
             # would resume a later stream at positions THIS state
-            # never trained.
+            # never trained. The vocab sidecar equally so: a stale
+            # slot map would remap ids onto rows THIS table never
+            # assigned them.
             for stale in (self._epoch_sidecar(fresh_step), mp,
-                          mp + ".tmp", wp, wp + ".tmp"):
+                          mp + ".tmp", wp, wp + ".tmp", vp,
+                          vp + ".tmp"):
                 try:
                     os.remove(stale)
                 except FileNotFoundError:
@@ -617,6 +750,22 @@ class CheckpointState:
                 np.int64(override), label="checkpoint/epoch_override"))
         if override >= 0:
             restored["epoch"] = np.int64(override)
+        return restored
+
+    def _attach_vocab(self, step: int, restored):
+        """Overlay the step's vocab-admission sidecar (vocab_mode =
+        admit) onto a restored tree as ``restored["vocab_admission"]``
+        (None when absent — every fixed-mode checkpoint). Same
+        process-0-reads + broadcast protocol as the stream watermark,
+        and for the same reason: divergent admission state across
+        hosts would remap the same id onto different rows."""
+        if restored is None:
+            return restored
+        payload = None
+        if jax.process_index() == 0:
+            payload = read_vocab_sidecar(self.directory, step)
+        payload = self._broadcast_json(payload, "checkpoint/vocab")
+        restored["vocab_admission"] = payload
         return restored
 
     def _attach_stream(self, step: int, restored):
@@ -728,7 +877,7 @@ class CheckpointState:
                                f"{QUARANTINE_PREFIX}{step}.{k}")
         os.rename(src, dst)
         for name in (f"manifest-{step}.json", f"epoch_override-{step}",
-                     f"watermark-{step}.json"):
+                     f"watermark-{step}.json", f"vocab-{step}.json.gz"):
             try:
                 os.replace(os.path.join(self.directory, name),
                            os.path.join(dst, name))
@@ -876,8 +1025,8 @@ class CheckpointState:
                 restored, err = self._attempt_restore(step, template)
                 if err is not None:
                     self._raise_restore_error(step, err)
-                return self._attach_stream(
-                    step, self._apply_epoch_override(step, restored))
+                return self._attach_vocab(step, self._attach_stream(
+                    step, self._apply_epoch_override(step, restored)))
             return self._restore_newest_intact(template)
 
     def _restore_newest_intact(self, template
@@ -928,8 +1077,8 @@ class CheckpointState:
                     if tel is not None:  # process 0 only: quarantined
                         # is always 0 elsewhere, so the count is global
                         tel.count("checkpoint/fallbacks")
-                return self._attach_stream(
-                    cand, self._apply_epoch_override(cand, restored))
+                return self._attach_vocab(cand, self._attach_stream(
+                    cand, self._apply_epoch_override(cand, restored)))
             if err is None:
                 # This process succeeded but a peer didn't: walk back
                 # with everyone (the restored tree may hold

@@ -211,6 +211,33 @@ class FmConfig:
     # publishing (periodic save_steps saves still apply).
     publish_interval_seconds: float = 0.0
 
+    # --- [Vocab] -----------------------------------------------------------
+    # Unbounded-vocabulary admission (README "Unbounded vocabulary";
+    # fast_tffm_tpu/vocab/). "fixed" (default) is the historical
+    # behavior — feature ids mod straight into the vocabulary_size
+    # table, bit-identical to every prior release. "admit" hashes ids
+    # into a large fixed space (2^30) and admits only ids whose
+    # sketched frequency crossed vocab_admit_threshold into private
+    # table rows; everything else shares one cold row (row 0), so the
+    # device table stays exactly vocabulary_size rows and batch shapes
+    # never move however many distinct ids the stream carries.
+    # Single-process only (the slot map is host state).
+    vocab_mode: str = "fixed"       # "fixed" | "admit"
+    # Sketched-frequency floor for admission AND eviction: an id is
+    # admitted once its count-min estimate reaches this (unit: batches
+    # the id appeared in), and a live row is evicted at a barrier once
+    # its decayed estimate falls below it.
+    vocab_admit_threshold: float = 2.0
+    # Per-barrier decay factor on every sketch counter (epoch
+    # boundary / publish settle): recency-weights the frequency so a
+    # formerly-hot id ages out instead of squatting its row forever.
+    # 1.0 = no decay (admission is then pure lifetime frequency).
+    vocab_decay: float = 0.5
+    # Count-min sketch budget in MB of float32 counters (4 hash rows).
+    # Bigger = fewer collisions = less over-admission; ~1 MB covers a
+    # ~10^5-id working set comfortably.
+    vocab_sketch_mb: float = 1.0
+
     # --- [Predict] ---------------------------------------------------------
     predict_files: Tuple[str, ...] = ()
     score_path: str = "./score"
@@ -427,6 +454,34 @@ class FmConfig:
                 "stream_dir is set but run_mode is 'epochs'; set "
                 "run_mode = stream (or drop stream_dir) — a silently "
                 "ignored stream directory is always a config mistake")
+        if self.vocab_mode not in ("fixed", "admit"):
+            raise ValueError(
+                f"unknown vocab_mode {self.vocab_mode!r} "
+                "(want fixed | admit)")
+        if self.vocab_admit_threshold < 1:
+            raise ValueError(
+                f"vocab_admit_threshold must be >= 1 (a count floor), "
+                f"got {self.vocab_admit_threshold}")
+        if not 0.0 < self.vocab_decay <= 1.0:
+            raise ValueError(
+                f"vocab_decay must be in (0, 1] (1 = no decay), got "
+                f"{self.vocab_decay}")
+        if self.vocab_sketch_mb <= 0:
+            raise ValueError(
+                f"vocab_sketch_mb must be > 0, got "
+                f"{self.vocab_sketch_mb}")
+        if self.vocab_mode == "admit" and self.vocabulary_size < 2:
+            raise ValueError(
+                "vocab_mode = admit needs vocabulary_size >= 2: row 0 "
+                "is the shared cold row, admitted ids get the rest")
+        if (self.vocab_mode == "admit" and self.run_mode == "stream"
+                and self.publish_interval_seconds <= 0):
+            raise ValueError(
+                "vocab_mode = admit with run_mode = stream needs "
+                "publish_interval_seconds > 0: admission/eviction "
+                "barriers ride publish settles, so a never-publishing "
+                "stream would never admit a single id — the whole run "
+                "would silently train through the shared cold row")
         if not self.serve_host:
             raise ValueError(
                 "serve_host must be a bind address (127.0.0.1 for "
@@ -576,6 +631,12 @@ _TRAIN_KEYS = {
     "seal_policy": str,
     "publish_interval_seconds": float,
 }
+_VOCAB_KEYS = {
+    "vocab_mode": str,
+    "vocab_admit_threshold": float,
+    "vocab_decay": float,
+    "vocab_sketch_mb": float,
+}
 _PREDICT_KEYS = {
     "predict_files": _split_files,
     "score_path": str,
@@ -613,8 +674,8 @@ def load_config(path: str) -> FmConfig:
     # The one section->keys mapping: drives both the consume loop and
     # the wrong-section hint, so the two cannot diverge.
     sections = {"General": _GENERAL_KEYS, "Train": _TRAIN_KEYS,
-                "Predict": _PREDICT_KEYS, "Serve": _SERVE_KEYS,
-                "Cluster": _CLUSTER_KEYS}
+                "Vocab": _VOCAB_KEYS, "Predict": _PREDICT_KEYS,
+                "Serve": _SERVE_KEYS, "Cluster": _CLUSTER_KEYS}
 
     def consume(section: str, keys):
         if not cp.has_section(section):

@@ -149,6 +149,18 @@ class DeviceBatch:
     # unstepped batches must not advance the stream position). None
     # everywhere outside stream mode.
     stream_pos: Optional[dict] = None
+    # vocab_mode = admit only (vocab/table.py): the batch's distinct
+    # HASHED ids, attached by the remap seam — the train loop feeds
+    # them to the admission sketch only once the batch is STEPPED
+    # (the stream_pos adopt-on-step rule, applied to admission state
+    # so it round-trips checkpoints exactly-once). None otherwise.
+    vocab_obs: Optional[np.ndarray] = None
+    # Admit mode only: the slot-map generation the remap ran under and
+    # the retained hash-space originals (references, not copies) — the
+    # train loop's ensure_current redoes a remap whose map a barrier
+    # moved while the batch sat prefetched (vocab/table.py).
+    vocab_gen: Optional[int] = None
+    vocab_src: Optional[tuple] = None
 
     @property
     def shape_key(self) -> Tuple[int, int, int, bool]:
@@ -1416,7 +1428,8 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                    stats: Optional[SpillStats] = None,
                    raw_ids: bool = False,
                    bad_lines: Optional[BadLineTracker] = None,
-                   file_marks: Optional[FileMarks] = None
+                   file_marks: Optional[FileMarks] = None,
+                   vocab=None
                    ) -> Iterator[DeviceBatch]:
     """Epoch/shuffle/batch loop over text files (see _batch_iterator_impl
     for the full contract). This wrapper is the pipeline's telemetry
@@ -1425,9 +1438,20 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
     a build-seconds histogram — timed HERE, on the producing side, so
     under prefetch it measures actual build cost on the worker thread,
     not consumer stall. Inactive (the default), batches pass straight
-    through."""
+    through.
+
+    ``vocab`` (a vocab.VocabMap/VocabRuntime; vocab_mode = admit) is
+    ALSO seamed here: the inner iterator builds batches in the hashed
+    id space (``vocab.build_cfg`` — same config, vocabulary_size
+    swapped for the 2^30 hash space, so every parser/builder below
+    mods into it), and every emitted batch is remapped to physical
+    rows before anything downstream — telemetry included — sees it.
+    None (the default, and always for vocab_mode = fixed) is
+    bit-identical to the historical pipeline."""
     from fast_tffm_tpu.obs.telemetry import active
-    it = _batch_iterator_impl(cfg, files, training=training,
+    it = _batch_iterator_impl(cfg if vocab is None
+                              else vocab.build_cfg(cfg), files,
+                              training=training,
                               weight_files=weight_files,
                               shard_index=shard_index,
                               num_shards=num_shards, epochs=epochs,
@@ -1439,7 +1463,11 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
                               file_marks=file_marks)
     tel = active()
     if tel is None:
-        yield from it
+        if vocab is None:
+            yield from it
+        else:
+            for batch in it:
+                yield vocab.remap(batch)
         return
     import time as _time
     from fast_tffm_tpu.obs.trace import span
@@ -1455,6 +1483,11 @@ def batch_iterator(cfg: FmConfig, files: Sequence[str],
             batch = next(it, None)
         if batch is None:
             return
+        if vocab is not None:
+            # Remap INSIDE the build bracket (it is build cost) and
+            # before pipeline_batch: the padding-waste counter must
+            # see the physical pad_id the remap writes.
+            batch = vocab.remap(batch)
         # fmlint: disable=R003 -- closes the build-seconds sample
         tel.pipeline_batch(batch, pad_id,
                            build_seconds=_time.perf_counter() - t0)

@@ -618,11 +618,19 @@ class StreamSource:
                  stop=None, fixed_shape: bool = False,
                  uniq_bucket: int = 0, raw_ids: bool = False,
                  workers: int = 1,
-                 bad_lines: Optional[BadLineTracker] = None):
+                 bad_lines: Optional[BadLineTracker] = None,
+                 vocab=None):
         from fast_tffm_tpu.data import cparser
         from fast_tffm_tpu.data.pipeline import (_BatchEmitter,
                                                  effective_L_cap)
         self.cfg = cfg
+        # The BUILD-side config (vocab_mode = admit): parsers/builders
+        # mod ids into the hash space; every emitted batch is remapped
+        # to physical rows (vocab.remap) before it reaches the ready
+        # deque — the same seam batch_iterator applies in epoch mode.
+        self._vocab = vocab
+        bcfg = cfg if vocab is None else vocab.build_cfg(cfg)
+        self._bcfg = bcfg
         self.tracker = tracker
         self._stop_cb = stop or (lambda: False)
         self.B = cfg.batch_size
@@ -636,7 +644,8 @@ class StreamSource:
         # is also what makes the watermark a per-file prefix.
         from fast_tffm_tpu.data.pipeline import SpillStats
         self.stats = SpillStats()
-        self._emitter = _BatchEmitter(cfg, self.B, effective_L_cap(cfg),
+        self._emitter = _BatchEmitter(bcfg, self.B,
+                                      effective_L_cap(bcfg),
                                       fixed_shape, uniq_bucket,
                                       shuffle=False, seed=cfg.seed,
                                       stats=self.stats)
@@ -667,7 +676,7 @@ class StreamSource:
                 feed_threads = pl._worker_feed_threads(self._workers,
                                                        False)
                 self._make_builder = functools.partial(
-                    pl._make_builder, cfg, self.B, raw_ids, False,
+                    pl._make_builder, bcfg, self.B, raw_ids, False,
                     fixed_shape, uniq_bucket, feed_threads)
                 self._init_ring()
             else:
@@ -678,7 +687,7 @@ class StreamSource:
                 # chunk up front) — same constraint as the epoch
                 # plane's spill rewind.
                 self._make_builder = functools.partial(
-                    pl._make_builder, cfg, self.B, raw_ids, False,
+                    pl._make_builder, bcfg, self.B, raw_ids, False,
                     fixed_shape, uniq_bucket, 1)
                 self._bb = self._make_builder()
         else:
@@ -712,6 +721,11 @@ class StreamSource:
 
     def _emit(self, out, spilled: bool) -> None:
         for batch in self._emitter.emit_drain(out, spilled):
+            if self._vocab is not None:
+                # Hash-space -> physical rows (vocab/table.py), before
+                # telemetry sees the batch: the pad-waste counter
+                # below reads the PHYSICAL pad_id.
+                batch = self._vocab.remap(batch)
             batch.stream_pos = self._snapshot()
             tel = StreamTracker._tel()
             if tel is not None:
@@ -946,7 +960,7 @@ class StreamSource:
         lines = [t[0] for t in take]
         if self.bad_lines is None:
             try:
-                block = _parse_block(lines, self.cfg, None)
+                block = _parse_block(lines, self._bcfg, None)
             except ParseError as e:
                 _, fi, _, ln = take[0]
                 raise ParseError(
@@ -954,7 +968,7 @@ class StreamSource:
                     f"{_strip_line_prefix(str(e))}") from None
         else:
             bads: List[Tuple[int, str, str]] = []
-            block = _salvage_block(lines, self.cfg, False, bads)
+            block = _salvage_block(lines, self._bcfg, False, bads)
             self.bad_lines.count_ok(len(lines) - len(bads))
             for i, raw, msg in bads:
                 _, fi, _, ln = take[i]
@@ -962,9 +976,11 @@ class StreamSource:
                                       _strip_line_prefix(msg))
         if block.batch_size:
             out_batch = make_device_batch(
-                block, self.cfg, batch_size=self.B,
+                block, self._bcfg, batch_size=self.B,
                 fixed_shape=self.fixed_shape,
                 uniq_bucket=self.uniq_bucket, raw_ids=self.raw_ids)
+            if self._vocab is not None:
+                out_batch = self._vocab.remap(out_batch)
             # EVERY file the chunk touches advances — a batch spanning
             # a file boundary must record the earlier files' final
             # included positions too, or a mid-stream checkpoint would

@@ -145,6 +145,18 @@ class HostOffloadLookup:
         """(table, acc) in the checkpoint layout — zero-copy."""
         return self.table, self.acc
 
+    def reset_rows(self, rows: np.ndarray,
+                   adagrad_init: float = 0.1) -> None:
+        """Cold-start the given physical rows: zero embeddings,
+        re-initialized accumulator. The vocab-admission barrier's
+        eviction hook (vocab/table.py) — an evicted id's old row must
+        not leak its trained embedding to the row's next owner. Part
+        of the slot-indirection seam every backend implements (the
+        device path uses vocab.table.reset_table_rows)."""
+        self.table[rows] = 0.0
+        if self.acc is not None:
+            self.acc[rows] = np.float32(adagrad_init)
+
     # --- persistence -------------------------------------------------
 
     def load(self, table: np.ndarray,
@@ -316,6 +328,24 @@ def _commit_fn(pinned: bool):
     import jax
     s_host, _, _ = _placement(pinned)
     return jax.jit(lambda x: x, out_shardings=s_host)
+
+
+@functools.lru_cache(maxsize=None)
+def _reset_rows_fn(pinned: bool, dim: int, adagrad_init: float):
+    """jit: zero the given table rows / re-init the acc rows, in the
+    state placement — the pinned backend's half of the vocab eviction
+    seam (fixed RESET_CHUNK-wide index array: one compile ever)."""
+    import jax
+    from fast_tffm_tpu.vocab.table import reset_body
+    s_host, _, ctx = _placement(pinned)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1),
+                       out_shardings=(s_host, s_host))
+    def reset(table, acc, rows):
+        with ctx():
+            return reset_body(table, acc, rows, adagrad_init)
+
+    return reset
 
 
 @functools.lru_cache(maxsize=None)
@@ -528,6 +558,24 @@ class PinnedHostLookup:
         in accelerator-host memory; checkpointing fetches their bytes
         (unavoidable for any durable save)."""
         return self.table, self.acc
+
+    def reset_rows(self, rows, adagrad_init: float = 0.1) -> None:
+        """Cold-start the given physical rows in place (the vocab
+        eviction hook — see HostOffloadLookup.reset_rows): a jitted
+        fixed-width scatter in the state placement, so barriers never
+        add a compile per eviction count and the state never leaves
+        host memory space."""
+        from fast_tffm_tpu.vocab.table import reset_chunks
+        fn = _reset_rows_fn(self._pinned, self.dim,
+                            float(adagrad_init))
+        pad_row = self.rows - 1  # dead ckpt-alignment tail row
+        if self.acc is None:
+            raise RuntimeError(
+                "reset_rows needs the accumulator: eviction resets are "
+                "a training-side operation (score-only backends never "
+                "see a barrier)")
+        for chunk in reset_chunks(rows, pad_row):
+            self.table, self.acc = fn(self.table, self.acc, chunk)
 
     # --- persistence (mirrors HostOffloadLookup) ---------------------
 

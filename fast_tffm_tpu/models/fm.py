@@ -272,6 +272,9 @@ def train_step_body(spec: ModelSpec, table, acc, labels, weights, uniq_ids,
                 "set); build batches with raw_ids=True — slot indices "
                 "read as feature ids would silently corrupt training")
         uniq_ids, local_idx = _device_dedup(spec, local_idx)
+    # fmlint: disable=R011 -- the jitted step BELOW the slot seam:
+    # uniq_ids reaching here are already physical rows (the data
+    # plane remapped them in admit mode)
     gathered = table[uniq_ids]
     loss, scores, grad = grad_body(spec, gathered, labels, weights,
                                    uniq_ids, local_idx, vals, fields,
@@ -326,10 +329,14 @@ def score_body(spec: ModelSpec, table, uniq_ids, local_idx, vals,
                 "dedup=device scorer got a host-deduped batch (uniq_ids "
                 "is set); build batches with raw_ids=True")
         B, L = local_idx.shape
+        # fmlint: disable=R011 -- raw-gather scorer below the slot
+        # seam: admit-mode callers remapped local_idx already
         gathered = table[local_idx.ravel()]
         idx = jnp.arange(B * L, dtype=jnp.int32).reshape(B, L)
         return rows_score_body(spec, gathered, idx, vals, fields,
                                mesh=mesh)
+    # fmlint: disable=R011 -- score path below the slot seam (ids
+    # already physical)
     gathered = table[uniq_ids]
     return rows_score_body(spec, gathered, local_idx, vals, fields,
                            mesh=mesh)

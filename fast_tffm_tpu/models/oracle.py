@@ -18,6 +18,9 @@ Examples are (ids, vals) lists; tables are dense numpy arrays with the
 reference's row layout ``[vocab, k + 1]`` — k latent factors then one
 linear weight per row (SURVEY §2 "Model parameters").
 """
+# fmlint: disable-file=R011 -- the oracle IS reference math on a dense
+# table callers index by physical row; tests hand it already-mapped ids
+
 
 from __future__ import annotations
 

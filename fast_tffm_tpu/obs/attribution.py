@@ -203,6 +203,21 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
             "stream/last_publish_age_seconds"),
         "stream_publish_interval_seconds": g.get(
             "stream/publish_interval_seconds"),
+        # Vocabulary admission (README "Unbounded vocabulary";
+        # vocab_mode = admit): cumulative distinct-id observations and
+        # how many of them hit the shared cold row, plus barrier
+        # admission/eviction totals and the live-row/sketch gauges the
+        # COLD-ROW SATURATION verdict reads.
+        "vocab_ids": c.get("vocab/ids", 0),
+        "vocab_cold_ids": c.get("vocab/cold_ids", 0),
+        "vocab_cold_hit_rate": _frac(c.get("vocab/cold_ids"),
+                                     c.get("vocab/ids")),
+        "vocab_admitted": c.get("vocab/admitted_rows", 0),
+        "vocab_evicted": c.get("vocab/evicted_rows", 0),
+        "vocab_candidates_dropped": c.get("vocab/candidates_dropped",
+                                          0),
+        "vocab_live_rows": g.get("vocab/live_rows"),
+        "vocab_sketch_fill": g.get("vocab/sketch_fill"),
     }
 
     # Serving (README "Serving"; fast_tffm_tpu/serve/): request/latency
@@ -302,6 +317,29 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
 # named the bound; below it the sweep's time is in score dispatch +
 # device compute, which host-side timing cannot split further.
 PREDICT_STAGE_BOUND_FRACTION = 0.5
+
+# Cold-row saturation floor (vocab_mode = admit): when more than this
+# fraction of the run's distinct-id observations landed on the shared
+# cold row, the table is too small for the stream's hot set — most of
+# what the model sees trains one communal embedding. The VOCAB section
+# names it and the fix (raise vocabulary_size, or lower
+# vocab_admit_threshold so the hot set actually admits).
+COLD_SATURATION_FRACTION = 0.5
+
+
+def vocab_verdict(att: Dict[str, Any]) -> Optional[str]:
+    """The VOCAB section's verdict line, or None while admission is
+    healthy. Only meaningful on a stream that ran admission at all
+    (vocab/ids > 0)."""
+    rate = att.get("vocab_cold_hit_rate")
+    if rate is None or not att.get("vocab_ids"):
+        return None
+    if rate > COLD_SATURATION_FRACTION:
+        return (f"COLD-ROW SATURATION: {rate:.0%} of distinct-id "
+                "observations hit the shared cold row — the hot set "
+                "outgrew the table; raise vocabulary_size or lower "
+                "vocab_admit_threshold")
+    return None
 
 
 def _predict_verdict(att: Dict[str, Any]) -> str:
@@ -678,6 +716,23 @@ def render(summary: Dict[str, Any]) -> str:
                  f"{_fmt(age)} / {_fmt(interval)}"),
         ):
             lines.append(f"    {k:<32} {v}")
+    if att["vocab_ids"] or att["vocab_live_rows"] is not None:
+        lines.append("  VOCAB (vocab_mode = admit):")
+        for k, v in (
+                ("live rows", att["vocab_live_rows"]),
+                ("admitted / evicted (barriers)",
+                 f"{_fmt(att['vocab_admitted'])} / "
+                 f"{_fmt(att['vocab_evicted'])}"),
+                ("cold-row hit rate",
+                 att["vocab_cold_hit_rate"]),
+                ("sketch fill", att["vocab_sketch_fill"]),
+                ("candidates dropped",
+                 att["vocab_candidates_dropped"]),
+        ):
+            lines.append(f"    {k:<32} {_fmt(v)}")
+        vv = vocab_verdict(att)
+        if vv is not None:
+            lines.append(f"    {vv}")
     if att["serve_requests"] or att["serve_served_step"] is not None:
         lines.append("  SERVING (run_tffm.py serve):")
         for k, v in (

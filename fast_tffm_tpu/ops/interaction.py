@@ -38,6 +38,8 @@ def gather_rows(table: jax.Array, uniq_ids: jax.Array) -> jax.Array:
     Padding slots hold ``pad_id == vocabulary_size`` which indexes the
     dead extra row (all-zero, never updated), so no clipping is needed.
     """
+    # fmlint: disable=R011 -- the one sanctioned batch gather below
+    # the slot seam (admit-mode ids are already physical rows here)
     return table[uniq_ids]
 
 

@@ -35,7 +35,8 @@ from fast_tffm_tpu.utils.logging import get_logger
 
 
 def load_table(cfg: FmConfig, mesh=None,
-               step: Optional[int] = None) -> jax.Array:
+               step: Optional[int] = None,
+               with_step: bool = False):
     """Restore the table from the latest checkpoint — or, with an
     explicit ``step``, those exact verified bytes (the serving
     process's hot-reload load, and the soak's per-step parity control;
@@ -45,7 +46,11 @@ def load_table(cfg: FmConfig, mesh=None,
     With a mesh: restored ROW-SHARDED in the [ckpt_rows, D] checkpoint
     layout — the full table never materializes on one device or host
     (BASELINE config #5 scale: 10^9 rows ~ 36 GB dense). Without: the
-    logical [num_rows, D] table on the default device."""
+    logical [num_rows, D] table on the default device.
+
+    ``with_step=True`` returns ``(table, step)`` — callers that must
+    pair the table with its step's sidecars (the admit-mode vocab slot
+    map) need to know which step the walk-back actually restored."""
     import jax.numpy as jnp
     from fast_tffm_tpu.train import checkpoint_template
     from fast_tffm_tpu.utils.retry import RetryPolicy
@@ -61,15 +66,19 @@ def load_table(cfg: FmConfig, mesh=None,
             "(run training first)")
     from fast_tffm_tpu.train import check_restored_vocab
     check_restored_vocab(cfg, restored)
+    loaded_step = int(restored["step"])
     if mesh is not None:
-        return restored["table"]
-    # Checkpoints store the 4096-aligned [ckpt_rows, D] layout; the
-    # single-device scorer wants the logical table.
-    return jnp.asarray(restored["table"][:cfg.num_rows], dtype=jnp.float32)
+        table = restored["table"]
+    else:
+        # Checkpoints store the 4096-aligned [ckpt_rows, D] layout;
+        # the single-device scorer wants the logical table.
+        table = jnp.asarray(restored["table"][:cfg.num_rows],
+                            dtype=jnp.float32)
+    return (table, loaded_step) if with_step else table
 
 
 def predict_scores(cfg: FmConfig, table: jax.Array, files,
-                   mesh=None, backend=None) -> np.ndarray:
+                   mesh=None, backend=None, vocab=None) -> np.ndarray:
     """Raw scores for every example in ``files``, in input order. With a
     mesh, the batch is data-sharded and scored against the row-sharded
     table in place (table shape [ckpt_rows, D]). With a lookup
@@ -81,7 +90,7 @@ def predict_scores(cfg: FmConfig, table: jax.Array, files,
     out: List[np.ndarray] = []
     score_sweep(cfg, table, files,
                 on_file=lambda _path, vals: out.append(vals),
-                mesh=mesh, backend=backend)
+                mesh=mesh, backend=backend, vocab=vocab)
     return (np.concatenate(out) if out
             else np.zeros(0, dtype=np.float32))
 
@@ -183,9 +192,22 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
     if jax.process_count() > 1:
         if cfg.lookup == "host":
             raise ValueError("lookup = host predict is single-process")
+        if getattr(cfg, "vocab_mode", "fixed") == "admit":
+            raise ValueError(
+                "vocab_mode = admit predict is single-process (the "
+                "slot map is host state; see the train-side "
+                "restriction)")
         return _predict_multiprocess(cfg, table, logger)
     mesh = None
     backend = None
+    vocab = None
+    admit = getattr(cfg, "vocab_mode", "fixed") == "admit"
+    if admit and table is not None:
+        raise ValueError(
+            "vocab_mode = admit predict restores the (table, slot "
+            "map, step) triple from the checkpoint together — a "
+            "caller-held table has no slot map to pair with; pass "
+            "table=None")
     if cfg.lookup == "host":
         # Offload predict (lookup.py seam): restore (or wrap a
         # caller-supplied table) into the best offload backend — pinned
@@ -218,8 +240,27 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
                         dict(mesh.shape), jax.device_count())
             if table is not None and int(table.shape[0]) != cfg.ckpt_rows:
                 table = place_table(cfg, mesh, table)
-    if table is None and backend is None:
-        table = load_table(cfg, mesh)
+    vstep = None
+    if backend is not None:
+        vstep = int(getattr(backend, "step", -1))
+    elif table is None:
+        table, vstep = load_table(cfg, mesh, with_step=True)
+    if not admit:
+        # The inverse loud-failure of the admit-without-sidecar raise
+        # below: an admit-trained table scored through modulo ids
+        # would gather arbitrary rows with zero errors.
+        from fast_tffm_tpu.checkpoint import refuse_fixed_mode_admit_step
+        refuse_fixed_mode_admit_step(
+            cfg, os.path.abspath(cfg.model_file) + ".ckpt", vstep)
+    if admit:
+        # Pair the restored table with ITS step's slot map — the
+        # sidecar rides checkpoints exactly like the watermark, so the
+        # walk-back can never split the (table, slot map) pair.
+        from fast_tffm_tpu.checkpoint import load_vocab_map
+        vocab = load_vocab_map(
+            cfg, os.path.abspath(cfg.model_file) + ".ckpt", vstep)
+        logger.info("vocab admission map: %d live rows at step %d",
+                    vocab.live_rows, vstep)
     os.makedirs(cfg.score_path, exist_ok=True)
     files = expand_files(cfg.predict_files)
     written: List[str] = []
@@ -259,7 +300,7 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
 
     try:
         n = score_sweep(cfg, table, files, on_file=on_file, mesh=mesh,
-                        backend=backend)
+                        backend=backend, vocab=vocab)
         writer.close()
     finally:
         writer.close(raise_error=False)
@@ -311,7 +352,15 @@ def _predict_multiprocess(cfg: FmConfig, table, logger) -> List[str]:
     logger.info("multi-process predict: %s over %d devices, %d processes",
                 dict(mesh.shape), jax.device_count(), jax.process_count())
     if table is None:
-        table = load_table(cfg, mesh)
+        table, vstep = load_table(cfg, mesh, with_step=True)
+        # Same admit-trained-under-fixed loud failure as the
+        # single-process path (admit itself is rejected before this
+        # branch): the existence probe is deterministic on the shared
+        # checkpoint dir, so every process raises uniformly — no
+        # collective divergence.
+        from fast_tffm_tpu.checkpoint import refuse_fixed_mode_admit_step
+        refuse_fixed_mode_admit_step(
+            cfg, os.path.abspath(cfg.model_file) + ".ckpt", vstep)
     spec = ModelSpec.from_config(cfg)
     score_fn = make_sharded_score_fn(spec, mesh)
     p, P = jax.process_index(), jax.process_count()

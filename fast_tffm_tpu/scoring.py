@@ -275,7 +275,7 @@ class ScoreDemux:
 
 def score_sweep(cfg: FmConfig, table, files: Sequence[str],
                 on_file: Callable[[str, np.ndarray], None],
-                mesh=None, backend=None) -> int:
+                mesh=None, backend=None, vocab=None) -> int:
     """Single-process continuous scoring sweep: one batch stream over
     ALL ``files`` (keep_empty: score files stay line-aligned), one
     overlap ChunkedFetcher for the whole sweep, per-file RAW score
@@ -302,9 +302,14 @@ def score_sweep(cfg: FmConfig, table, files: Sequence[str],
     # drains and joins the worker without masking the original error.
     try:
         with span("predict/sweep", files=len(files)):
+            # ``vocab`` (vocab_mode = admit): the pipeline builds in
+            # the hashed space and remaps through the checkpoint's
+            # slot map — the sweep scores exactly the rows training
+            # assigned (predict.py loads the (table, slot map, step)
+            # triple together).
             it = batch_iterator(cfg, files, training=False, epochs=1,
                                 keep_empty=True, raw_ids=scorer.raw,
-                                file_marks=marks)
+                                file_marks=marks, vocab=vocab)
             for batch in prefetch(it, depth=cfg.prefetch_depth,
                                   gil_bound=gil_bound_iteration(
                                       cfg, keep_empty=True)):

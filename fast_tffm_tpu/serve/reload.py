@@ -6,10 +6,13 @@ manifest-verified step, atomically. This thread is the serve side of
 that contract — re-read the pointer every ``serve_poll_seconds``, and
 when it names a step other than the one being served, restore it
 through the same verified-restore path (an explicit step is verified,
-never walked past) and hand it to the server's atomic swap. Requests
-in flight keep the table reference their flush captured: the old table
-is retained until the last batch referencing it drains — no torn
-scores, and every response says which step scored it.
+never walked past) and hand it to the server's atomic swap. Under
+``vocab_mode = admit`` the swap is the whole (table, slot map, step)
+TRIPLE — the step's vocab sidecar loads (crc-checked) before the
+swap, so a reload can never pair a new table with an old admission
+map. Requests in flight keep the pair their flush captured: the old
+table/map is retained until the last batch referencing it drains — no
+torn scores, and every response says which step scored it.
 
 Failure posture: a garbled/unreadable pointer reads as "nothing new"
 and heals on the next poll (read_published's contract); a step that

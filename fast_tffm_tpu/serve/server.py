@@ -190,6 +190,19 @@ class ScorerServer:
                      else MetricsRegistry())
         from fast_tffm_tpu.scoring import CompiledScorer
         self._scorer = CompiledScorer(cfg, dedup="device")
+        # Unbounded vocabulary (vocab_mode = admit; README "Unbounded
+        # vocabulary"): requests parse into the hashed id space and
+        # every flush remaps through the slot map loaded WITH the
+        # table — the (table, slot map, step) triple swaps atomically
+        # under _table_lock, so in-flight flushes drain on a coherent
+        # pair. Unadmitted ids score through the shared cold row.
+        self._admit = getattr(cfg, "vocab_mode", "fixed") == "admit"
+        if self._admit:
+            from fast_tffm_tpu.vocab.table import VocabMap
+            self._build_cfg = VocabMap.build_cfg(cfg)
+        else:
+            self._build_cfg = cfg
+        self._vocab_map = None
         self._b_ladder = batch_rung_ladder(cfg.serve_max_batch)
         self._l_rungs = tuple(
             b for b in cfg.bucket_ladder
@@ -268,15 +281,34 @@ class ScorerServer:
     def _load_step(self, step: int) -> None:
         """Verified restore of an explicit step (raises on integrity
         failure — never silently serves other bytes) + atomic swap.
-        In-flight flushes keep the table reference they captured until
-        their scores are fetched, so requests mid-air across a swap
-        drain on the OLD step and say so in their result."""
+        In-flight flushes keep the (table, slot map) pair they
+        captured until their scores are fetched, so requests mid-air
+        across a swap drain on the OLD step and say so in their
+        result. Admit mode loads the step's vocab sidecar BEFORE the
+        swap — a published step missing its slot map fails the reload
+        whole (the previous coherent triple keeps serving) rather
+        than pairing a new table with an old map."""
         from fast_tffm_tpu.predict import load_table
+        vmap = None
+        if self._admit:
+            # The shared inference loader: raises on a missing/torn
+            # sidecar — the reload fails whole and the previous
+            # coherent triple keeps serving.
+            from fast_tffm_tpu.checkpoint import load_vocab_map
+            vmap = load_vocab_map(self.cfg, self.directory, step)
+        else:
+            from fast_tffm_tpu.checkpoint import (
+                refuse_fixed_mode_admit_step)
+            refuse_fixed_mode_admit_step(self.cfg, self.directory, step)
         table = load_table(self.cfg, step=step)
         with self._table_lock:
             self._table = table
+            self._vocab_map = vmap
             self._served_step = int(step)
         self._reg.set("serve/served_step", float(step))
+        if vmap is not None:
+            self._reg.set("serve/vocab_live_rows",
+                          float(vmap.live_rows))
 
     def idle_beat(self) -> None:
         """Watchdog liveness for a traffic-idle server: flushes are
@@ -316,7 +348,9 @@ class ScorerServer:
     # -- request path ----------------------------------------------------
 
     def _parse(self, lines: Sequence[str]) -> ParsedBlock:
-        cfg = self.cfg
+        # Build-side config: identical to cfg except admit mode parses
+        # into the hashed id space (the flush remaps to physical rows).
+        cfg = self._build_cfg
         # keep_empty: one score per request line, exactly the predict
         # alignment contract — a blank line scores as the model bias.
         return parse_lines(
@@ -419,10 +453,13 @@ class ScorerServer:
             with self._table_lock:
                 table = self._table
                 step = self._served_step
+                vmap = self._vocab_map
             with span("serve/flush", examples=n, rung=rung):
-                batch = make_device_batch(block, self.cfg,
+                batch = make_device_batch(block, self._build_cfg,
                                           batch_size=rung,
                                           raw_ids=True)
+                if vmap is not None:
+                    batch = vmap.remap(batch)
                 raw = np.asarray(jax.device_get(
                     self._scorer.score_batch(table, batch)))[:n]
             vals = (sigmoid(raw) if self.cfg.loss_type == "logistic"
@@ -474,7 +511,7 @@ class ScorerServer:
         cached process-wide per (spec, shape) — jax's jit cache plus
         the persistent compilation cache run_tffm enables.)"""
         import jax
-        cfg = self.cfg
+        cfg = self._build_cfg
         t0 = time.monotonic()
         with span("serve/warmup", rungs=len(self._b_ladder)
                   * len(self._l_rungs)):
@@ -491,6 +528,8 @@ class ScorerServer:
                                 if cfg.model_type == "ffm" else None))
                     batch = make_device_batch(block, cfg, batch_size=B,
                                               raw_ids=True)
+                    if self._vocab_map is not None:
+                        batch = self._vocab_map.remap(batch)
                     jax.device_get(
                         self._scorer.score_batch(self._table, batch))
         self.compiled_shapes = tuple(

@@ -59,7 +59,8 @@ LOG_BUFFER_MAX = 1024
 def evaluate(cfg: FmConfig, table: jax.Array, files,
              max_batches: Optional[int] = None,
              mesh=None, backend=None,
-             weight_files=(), bad_lines=None) -> Tuple[float, int]:
+             weight_files=(), bad_lines=None,
+             vocab=None) -> Tuple[float, int]:
     """Streamed AUC over ``files``; returns (auc, n_examples). Pass the
     training mesh to score a row-sharded table in place, or a lookup
     ``backend`` (lookup.HostOffloadLookup) to score a host-offloaded
@@ -72,6 +73,12 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     spec = ModelSpec.from_config(cfg)
     score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
     raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
+    if vocab is not None:
+        # Telemetry-silent snapshot: a held-out sweep's unique tail is
+        # disproportionately unadmitted and would otherwise inflate
+        # the training stream's cold-hit rate (the COLD-ROW SATURATION
+        # verdict's input).
+        vocab = vocab.eval_view()
     auc = StreamingAUC()
     n = 0
     n_batches = 0
@@ -90,7 +97,8 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
         for batch in prefetch(batch_iterator(cfg, files, training=False,
                                              weight_files=weight_files,
                                              epochs=1, raw_ids=raw,
-                                             bad_lines=bad_lines),
+                                             bad_lines=bad_lines,
+                                             vocab=vocab),
                               depth=cfg.prefetch_depth,
                               gil_bound=gil_bound_iteration(
                                   cfg, weight_files)):
@@ -458,6 +466,29 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             val_bucket = cfg.uniq_bucket or probe_uniq_bucket(
                 cfg, cfg.validation_files)
 
+        # Vocabulary admission (README "Unbounded vocabulary";
+        # fast_tffm_tpu/vocab/): the runtime owns the sketch + slot
+        # map; the data plane builds batches in the hashed space and
+        # remaps through it; barriers run at the existing epoch/
+        # publish synchronization points below.
+        vocab = None
+        if getattr(cfg, "vocab_mode", "fixed") == "admit":
+            if multi_process:
+                raise ValueError(
+                    "vocab_mode = admit is single-process: the slot "
+                    "map is host state, and lockstep workers would "
+                    "need a chief-broadcast admission protocol to "
+                    "agree on it (ROADMAP item 3's sharded-table "
+                    "leg). Run admit-mode training on one process.")
+            from fast_tffm_tpu.vocab.table import VocabRuntime
+            vocab = VocabRuntime.from_config(cfg)
+            logger.info(
+                "vocab admission: %d physical rows (row 0 = shared "
+                "cold row) over a 2^30 hashed id space; admit/evict "
+                "threshold %.1f, decay %.2f/barrier, sketch %.1f MB",
+                cfg.vocabulary_size, cfg.vocab_admit_threshold,
+                cfg.vocab_decay, cfg.vocab_sketch_mb)
+
         ckpt = CheckpointState(cfg.model_file,
                                retry=RetryPolicy.from_config(cfg),
                                verify=getattr(cfg, "ckpt_verify", "size"))
@@ -470,6 +501,33 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             global_step = int(restored["step"])
             restored_epoch = int(restored["epoch"])
             logger.info("restored checkpoint at step %d", global_step)
+        vocab_fresh_over_restore = False
+        if vocab is not None and restored is not None:
+            payload = restored.get("vocab_admission")
+            if payload is None:
+                logger.warning(
+                    "restored checkpoint at step %d carries no vocab "
+                    "admission sidecar (a fixed-mode warm start, or a "
+                    "lost/garbled sidecar): admission state starts "
+                    "FRESH — previously admitted ids serve from the "
+                    "cold row until they re-cross the threshold",
+                    global_step)
+                # The restored table still holds the LOST mapping's
+                # trained rows; fresh admission must not hand them to
+                # new owners (see the cold-start reset below, once the
+                # table is materialized).
+                vocab_fresh_over_restore = True
+            else:
+                vocab.load(cfg, payload)
+                logger.info(
+                    "restored vocab admission state at step %d: %d "
+                    "live rows", global_step, vocab.live_rows)
+        elif restored is not None:
+            from fast_tffm_tpu.checkpoint import (
+                refuse_fixed_mode_admit_step)
+            refuse_fixed_mode_admit_step(
+                cfg, ckpt.directory, global_step,
+                payload=restored.get("vocab_admission"))
         restored_step = global_step
         start_epoch = resume_start_epoch(restored_epoch, cfg.epoch_num)
         if start_epoch:
@@ -530,6 +588,43 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 table = init_table(cfg, cfg.seed)
                 acc = init_accumulator(cfg)
             step_fn = make_train_step(spec)
+
+        def _vocab_reset(rows):
+            """The eviction hook: cold-start freed rows through the
+            backend's half of the slot seam (lookup.reset_rows for
+            offload state, the fixed-width compiled scatter for
+            device/mesh state — either way no per-count recompiles)."""
+            nonlocal table, acc
+            if offload:
+                lk.reset_rows(rows, cfg.adagrad_init)
+            else:
+                from fast_tffm_tpu.vocab.table import reset_table_rows
+                table, acc = reset_table_rows(table, acc, rows,
+                                              cfg.pad_id,
+                                              cfg.adagrad_init)
+
+        def _vocab_barrier(where: str) -> None:
+            if vocab is None:
+                return
+            st = vocab.barrier(_vocab_reset)
+            logger.info(
+                "vocab barrier (%s): +%d admitted, -%d evicted, %d/%d "
+                "live rows", where, st["admitted"], st["evicted"],
+                st["live"], cfg.vocabulary_size - 1)
+
+        if vocab_fresh_over_restore:
+            # Fresh admission over a restored table: every row —
+            # including row 0, which becomes the shared COLD row but
+            # held a fixed-mode mapping's trained embedding — still
+            # carries the LOST mapping's weights. Cold-start them all
+            # so neither the communal tail nor a newly admitted id
+            # ever trains through another id's vector (the documented
+            # row-owner invariant).
+            _vocab_reset(np.arange(0, cfg.vocabulary_size,
+                                   dtype=np.int32))
+            logger.info(
+                "cold-started %d table rows for the fresh admission "
+                "state", cfg.vocabulary_size)
 
         # Preemption handling (SURVEY §5 "Failure detection": the reference
         # only recovers via restart+restore; we additionally save on the way
@@ -738,7 +833,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                       else (lambda: bool(preempted))),
                 fixed_shape=multi_process, uniq_bucket=u_bucket,
                 raw_ids=raw_mode, workers=workers,
-                bad_lines=bad_tracker)
+                bad_lines=bad_tracker, vocab=vocab)
             publish_every = float(
                 getattr(cfg, "publish_interval_seconds", 0.0))
             last_publish = [time.monotonic()]
@@ -775,7 +870,9 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 ckpt.save(global_step, *state,
                           vocabulary_size=cfg.vocabulary_size,
                           force=force, wait=wait, epoch=0,
-                          stream_state=_stream_state_for_save())
+                          stream_state=_stream_state_for_save(),
+                          vocab_state=(vocab.state_payload()
+                                       if vocab is not None else None))
                 last_periodic_save = (global_step, 0)
                 if tel is not None:
                     tel.count("train/checkpoints")
@@ -790,7 +887,21 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # checkpoint_pause_seconds counter (the publish
                     # span is the timeline view)
                     t_pub = time.perf_counter()
-                    stream_save(wait=True)
+                    # Publish settle IS a vocab barrier point: the
+                    # published (table, slot map, step) triple a
+                    # scorer hot-reloads must be post-admission/
+                    # eviction coherent — evicted rows reset BEFORE
+                    # the save, so the published step serves evicted
+                    # ids from the cold row, never stale embeddings.
+                    _vocab_barrier(f"publish step {global_step}")
+                    # force=True: a publish can land on the SAME step
+                    # as the last periodic save, and the barrier above
+                    # just moved the in-memory (table, slot map) pair —
+                    # the benign same-step-collision skip would pair
+                    # the old arrays with the new sidecar. Forcing
+                    # rewrites both, so the published triple is
+                    # coherent.
+                    stream_save(wait=True, force=vocab is not None)
                     ckpt.publish_step(global_step)
                     if tel is not None:
                         # fmlint: disable=R003 -- closes the sample
@@ -806,6 +917,13 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             def step_once(batch) -> None:
                 nonlocal global_step, loss, stream_watermark
                 nonlocal table, acc
+                if vocab is not None:
+                    # A publish barrier may have moved the slot map
+                    # while this batch sat in the prefetch queue —
+                    # redo its remap so it never scatters into rows
+                    # the barrier evicted/reset/reassigned (one int
+                    # compare when nothing moved).
+                    batch = vocab.ensure_current(batch)
                 args = batch_args(batch)
                 h2d_bytes = (batch_payload_bytes(args)
                              if tel is not None else 0)
@@ -831,6 +949,12 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # The durable position advances ONLY with stepped
                     # batches (lockstep fillers carry None).
                     stream_watermark = batch.stream_pos
+                if vocab is not None:
+                    # Adopt-on-step, like the watermark: the sketch
+                    # advances only for trained batches, so the
+                    # checkpointed admission state and the stream
+                    # position describe the same prefix.
+                    vocab.note_trained(batch)
                 n_global = batch.num_real * (jax.process_count()
                                              if multi_process else 1)
                 timer.tick(n_global)
@@ -979,7 +1103,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 num_shards=num_shards, epochs=1, seed=cfg.seed + epoch,
                 fixed_shape=multi_process, uniq_bucket=uniq_bucket,
                 stats=epoch_stats, raw_ids=raw_mode,
-                bad_lines=bad_tracker),
+                bad_lines=bad_tracker, vocab=vocab),
                 depth=cfg.prefetch_depth,
                 gil_bound=gil_bound_iteration(cfg, cfg.weight_files))
             # fmlint: disable=R003 -- anchors the per-epoch
@@ -1054,6 +1178,12 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                         break
                     if batch is None:
                         break
+                if vocab is not None:
+                    # Epoch barriers only run once the epoch's iterator
+                    # is exhausted, so nothing should be stale here —
+                    # this is the one-integer-compare insurance the
+                    # stream loop actually needs (see step_once).
+                    batch = vocab.ensure_current(batch)
                 args = batch_args(batch)
                 # H2D payload sized host-side, BEFORE placement turns
                 # the numpy arrays into device arrays.
@@ -1094,6 +1224,9 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                                                           **args)
                 global_step += 1
                 last_val = None  # table advanced; any cached AUC is stale
+                if vocab is not None:
+                    vocab.note_trained(batch)  # adopt-on-step: only
+                    # TRAINED batches feed the admission sketch
                 n_global = batch.num_real * (jax.process_count()
                                              if multi_process else 1)
                 timer.tick(n_global)
@@ -1152,7 +1285,10 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # race the in-place numpy Adagrad updates.
                     ckpt.save(global_step, *state,
                               vocabulary_size=cfg.vocabulary_size,
-                              wait=offload, epoch=completed_epochs)
+                              wait=offload, epoch=completed_epochs,
+                              vocab_state=(vocab.state_payload()
+                                           if vocab is not None
+                                           else None))
                     last_periodic_save = (global_step, completed_epochs)
                     if tel is not None:
                         # fmlint: disable=R003 -- closes the pause sample
@@ -1204,6 +1340,12 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     cfg, uniq_bucket, int(tot[:, 0].sum()),
                     int(tot[:, 1].sum()), logger,
                     max_uniq=int(tot[:, 2].max()))
+            if not stopping:
+                # The epoch boundary IS a vocab barrier point: the
+                # epoch's observations admit/evict here, so the next
+                # epoch (and the validation sweep just below) runs
+                # against the refreshed map + reset rows.
+                _vocab_barrier(f"epoch {epoch}")
             if cfg.validation_files and not stopping:
                 # fmlint: disable=R003 -- feeds the train/
                 # validation_seconds counter (the train/validation span
@@ -1231,7 +1373,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                             cfg, table, cfg.validation_files,
                             mesh=mesh, backend=lk, max_batches=vmb,
                             weight_files=cfg.validation_weight_files,
-                            bad_lines=bad_tracker)
+                            bad_lines=bad_tracker, vocab=vocab)
                 last_val = (auc, n)
                 if jax.process_index() == 0:
                     logger.info(
@@ -1266,6 +1408,15 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 completed_epochs = epoch + 1
         flush_log()
         loss_val = float(loss) if loss is not None else loss_val
+        # The final save IS a barrier point (vocab/table.py's contract):
+        # nothing is in flight here — the stream is drained or the
+        # epoch iterators exhausted — so the durable (table, slot map)
+        # pair admits the last interval's crossers and evicts/resets
+        # its cold rows before the bytes land (the exit publish below
+        # repoints at exactly this state). MUST run before state() is
+        # captured: the row resets donate (and for the device path
+        # reassign) the table/acc buffers.
+        _vocab_barrier(f"final save step {global_step}")
         state = lk.state() if offload else ckpt_state(cfg, table, acc)
         # Final/preemption save: barrier until durably written — the
         # process may exit right after.
@@ -1290,7 +1441,9 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                   vocabulary_size=cfg.vocabulary_size, force=True,
                   wait=True, epoch=completed_epochs,
                   rewrite_stale_metadata=stale,
-                  stream_state=_stream_state_for_save())
+                  stream_state=_stream_state_for_save(),
+                  vocab_state=(vocab.state_payload()
+                               if vocab is not None else None))
         if stream_mode and getattr(cfg, "publish_interval_seconds",
                                    0.0) > 0:
             # The exit publish: a clean STOP drain (or a preemption's
@@ -1308,7 +1461,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 cfg, table, cfg.validation_files, mesh=mesh,
                 backend=lk, max_batches=cfg.validation_max_batches
                 or None, weight_files=cfg.validation_weight_files,
-                bad_lines=bad_tracker)
+                bad_lines=bad_tracker, vocab=vocab)
             logger.info("final validation AUC %.6f over %d examples",
                         auc, n)
             if tel is not None:

@@ -1,0 +1,24 @@
+"""Vocabulary management — frequency-gated admission over an unbounded
+hashed id space (README "Unbounded vocabulary").
+
+``vocab_mode = fixed`` (the default) is the historical behavior:
+feature ids mod straight into a dense table of ``vocabulary_size``
+rows, every distinct id colliding into the fixed array. ``vocab_mode =
+admit`` opens the id space: ids hash into a large fixed space
+(``sketch.HASH_SPACE``) and a host-side slot map assigns the HOT ids —
+those whose sketched frequency crossed ``vocab_admit_threshold`` —
+private physical rows, while everything else shares one cold row. The
+device table stays exactly ``vocabulary_size`` rows and batch shapes
+never change, so the jitted step/score programs are untouched.
+
+- ``vocab/sketch.py``  — the count-min frequency sketch (host numpy).
+- ``vocab/table.py``   — slot map + the batch remap seam + the
+  epoch-/publish-batched admission/eviction barrier.
+"""
+
+from fast_tffm_tpu.vocab.sketch import HASH_SPACE, CountMinSketch
+from fast_tffm_tpu.vocab.table import (COLD_ROW, VocabMap, VocabRuntime,
+                                       payload_crc_ok)
+
+__all__ = ["HASH_SPACE", "COLD_ROW", "CountMinSketch", "VocabMap",
+           "VocabRuntime", "payload_crc_ok"]

@@ -128,3 +128,59 @@ def test_gc_dry_run_then_delete(tmp_path, capsys):
     # committed steps and their manifests are never gc'd
     assert list_step_dirs(ckpt.directory) == [1]
     assert os.path.exists(manifest_path(ckpt.directory, 1))
+
+
+def test_ls_and_verify_cover_vocab_sidecar(tmp_path, capsys):
+    """ISSUE 12 satellite: steps carrying a vocab admission sidecar get
+    a +VOCAB mark in ls, verify re-checks the sidecar's embedded crc32
+    (OK note on the good step), and a garbled sidecar is a verify FAIL
+    — an admit-mode restore would silently fall back to fresh
+    admission state, so the operator must see it before pointing a
+    scorer at the step."""
+    import re
+
+    import numpy as np
+
+    from fast_tffm_tpu.checkpoint import vocab_sidecar_path
+    from fast_tffm_tpu.vocab.sketch import CountMinSketch
+    from fast_tffm_tpu.vocab.table import VocabRuntime
+
+    cfg = FmConfig(vocabulary_size=500, factor_num=4,
+                   model_file=str(tmp_path / "m" / "fm"))
+    table, acc = ckpt_state(cfg, init_table(cfg), init_accumulator(cfg))
+    ckpt = CheckpointState(cfg.model_file)
+    from types import SimpleNamespace
+    rt = VocabRuntime(cfg.vocabulary_size, cfg.pad_id, 2.0, 0.5,
+                      CountMinSketch(width=256))
+    for _ in range(4):
+        rt.note_trained(SimpleNamespace(
+            vocab_obs=np.array([11, 22], np.int64)))
+    rt.barrier(None)
+    assert rt.live_rows == 2  # the sidecar under test is non-trivial
+    ckpt.save(1, table, acc, vocabulary_size=cfg.vocabulary_size,
+              wait=True, epoch=0)
+    ckpt.save(2, table, acc, vocabulary_size=cfg.vocabulary_size,
+              wait=True, epoch=0, vocab_state=rt.state_payload())
+    ckpt.close()
+    assert main(["ls", cfg.model_file]) == 0
+    out = capsys.readouterr().out
+    lines = {int(m.group(1)): line for line in out.splitlines()
+             if (m := re.search(r"step (\d+)", line))}
+    assert "+VOCAB" not in lines[1]
+    assert "+VOCAB" in lines[2]
+    assert main(["verify", cfg.model_file]) == 0
+    out = capsys.readouterr().out
+    assert "step 2: OK" in out and "+vocab crc OK" in out
+    # Garble the sidecar: verify must FAIL the step and exit 1 — and
+    # publish must refuse to point a scorer fleet at it (every
+    # admit-mode reload of the step would raise).
+    with open(vocab_sidecar_path(ckpt.directory, 2), "wb") as fh:
+        fh.write(b"not gzip at all")
+    assert main(["verify", cfg.model_file]) == 1
+    out = capsys.readouterr().out
+    assert "step 2: FAIL" in out and "vocab sidecar" in out
+    from fast_tffm_tpu.checkpoint import read_published
+    assert main(["publish", cfg.model_file, "2"]) == 1
+    out = capsys.readouterr().out
+    assert "vocab sidecar" in out and "pointer untouched" in out
+    assert read_published(ckpt.directory) is None

@@ -488,3 +488,66 @@ def test_r999_fails_gate_for_expanded_surface(tmp_path):
     findings = run_paths([str(tmp_path)])
     assert [f.rule for f in findings] == ["R999"]
     assert findings[0].path.endswith("__init__.py")
+
+
+def test_r011_flags_raw_table_index(tmp_path):
+    """ISSUE 12 satellite: a raw ``table[ids]`` outside lookup.py/
+    vocab/ bypasses the slot-indirection seam — under vocab_mode =
+    admit it reads rows the slot map may have reassigned or reset."""
+    path = _any_file(tmp_path, """\
+        def gather(table, ids):
+            return table[ids]
+    """)
+    found = run_file(path)
+    assert [f.rule for f in found] == ["R011"]
+    assert "slot-indirection" in found[0].message
+
+
+def test_r011_flags_attribute_table_index(tmp_path):
+    path = _any_file(tmp_path, """\
+        def gather(self, ids):
+            return self.table[ids]
+    """)
+    assert [f.rule for f in run_file(path)] == ["R011"]
+
+
+def test_r011_allows_layout_slices_and_fixed_rows(tmp_path):
+    """Slices (checkpoint layout trims) and constant rows — negative
+    included (the dead tail row) — address LAYOUT, not id routing."""
+    path = _any_file(tmp_path, """\
+        def trim(table, n):
+            head = table[:n]
+            row0 = table[0]
+            tail = table[-1]
+            block = table[0:4, :]
+            corner = table[-1, :]
+            return head, row0, tail, block, corner
+    """)
+    assert run_file(path) == []
+
+
+def test_r011_exempts_lookup_and_vocab_modules(tmp_path):
+    """lookup.py and vocab/ ARE the seam — raw indexing there is the
+    implementation, not a bypass."""
+    body = """\
+        def gather(table, ids):
+            return table[ids]
+    """
+    d = tmp_path / "fast_tffm_tpu"
+    d.mkdir()
+    import textwrap as _tw
+    (d / "lookup.py").write_text(_tw.dedent(body))
+    v = d / "vocab"
+    v.mkdir()
+    (v / "table.py").write_text(_tw.dedent(body))
+    assert run_file(str(d / "lookup.py")) == []
+    assert run_file(str(v / "table.py")) == []
+
+
+def test_r011_respects_pragma(tmp_path):
+    path = _any_file(tmp_path, """\
+        def step(table, uniq_ids):
+            # fmlint: disable=R011 -- jitted step below the slot seam
+            return table[uniq_ids]
+    """)
+    assert run_file(path) == []

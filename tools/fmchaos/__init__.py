@@ -57,6 +57,17 @@ asserts the documented recovery behavior:
                       is quarantined through the BadLineTracker, the
                       run survives and finishes the successor shard,
                       breaker accounting exact.
+- ``vocab-churn``     unbounded-vocabulary admission under stream
+                      churn (``vocab_mode = admit``): a heavy-tailed
+                      hashed-id stream (distinct ids >= 10x the
+                      physical table) through a mid-run SIGTERM and a
+                      checkpoint walk-back → admission state
+                      round-trips bit-exactly, the slot map stays
+                      bounded at vocabulary_size rows, cold-gone hot
+                      ids are EVICTED at barriers, and the published
+                      step serves evicted ids from the shared cold
+                      row (bit-identical to a never-seen id), never
+                      their stale embeddings.
 - ``truncate-latest`` the newest checkpoint step is torn (truncated
                       array file) → with ``ckpt_verify = size`` the
                       restart quarantines it (``corrupt-<step>``,
@@ -925,6 +936,183 @@ def scenario_stream_truncate(workdir: str, seed: int = 0) -> str:
             "examples exactly once)")
 
 
+def scenario_vocab_churn(workdir: str, seed: int = 0) -> str:
+    """Unbounded-vocabulary admission under stream churn (README
+    "Unbounded vocabulary"): a streaming run over a heavy-tailed
+    hashed-id distribution — an early hot "era A" that goes cold, a
+    later "era B", and a long unique tail far exceeding
+    ``vocabulary_size`` — takes a mid-run SIGTERM, then resumes
+    through a checkpoint WALK-BACK (the newest step is torn, so
+    restore quarantines it and loads the older step's vocab sidecar).
+    Asserts: admission state round-trips the preemption bit-exactly
+    (payload -> load -> payload identity, and the resumed run logs the
+    walked-back step's own live-row count), the slot map never exceeds
+    the physical table (every row in [1, vocabulary_size), live <=
+    vocabulary_size - 1) while the distinct-id count is >= 10x it,
+    era-A rows are EVICTED once their decayed frequency falls below
+    the floor, and the final published step serves an evicted id from
+    the shared cold row — bit-identical to a never-seen id's score,
+    NOT its stale embedding."""
+    from fast_tffm_tpu.checkpoint import (QUARANTINE_PREFIX,
+                                          list_step_dirs,
+                                          read_published,
+                                          read_vocab_sidecar)
+    from fast_tffm_tpu.data.hashing import murmur64
+    from fast_tffm_tpu.testing.faults import (preempt_after_steps,
+                                              truncate_checkpoint)
+    from fast_tffm_tpu.train import train
+    from fast_tffm_tpu.vocab.sketch import HASH_SPACE
+    from fast_tffm_tpu.vocab.table import VocabRuntime, payload_crc_ok
+    import base64
+    workdir = os.path.abspath(workdir)
+    sd = os.path.join(workdir, "stream")
+    os.makedirs(sd, exist_ok=True)
+    V = 16  # physical table rows (1 cold + 15 live)
+    rng = np.random.default_rng(seed)
+    era_a = [f"hotA{i}" for i in range(4)]
+    era_b = [f"hotB{i}" for i in range(4)]
+    distinct = set()
+
+    def write_shard(i, hot):
+        lines = []
+        for k in range(400):
+            y = k % 2
+            h = hot[(k % 2) * 2 + (k % 4) // 2]
+            tail = f"u{int(rng.integers(0, 20000))}"
+            distinct.update((h, tail))
+            lines.append(f"{y} {h}:1 {tail}:0.5")
+        path = os.path.join(sd, f"part-{i:03d}.txt")
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        open(path + ".done", "w").close()
+
+    # LIVE writer with arrival gaps: publish barriers fire on the
+    # driver's idle ticks inside each gap, so admission/eviction
+    # decisions land deterministically BETWEEN eras regardless of how
+    # fast the machine steps a sealed shard.
+    import threading
+    import time as _time
+
+    def writer():
+        write_shard(0, era_a)       # era A: hot, then never again
+        _time.sleep(0.5)
+        write_shard(1, era_b)       # era B takes over
+        _time.sleep(0.5)
+        write_shard(2, era_b)
+        open(os.path.join(sd, "STOP"), "w").close()
+
+    w = threading.Thread(target=writer, name="vocab-churn-writer",
+                         daemon=True)
+    cfg = _stream_cfg(workdir, sd, hash_feature_id=True,
+                      vocabulary_size=V, save_steps=5,
+                      publish_interval_seconds=0.15,
+                      vocab_mode="admit", vocab_admit_threshold=2.0,
+                      vocab_decay=0.25, vocab_sketch_mb=0.25)
+    ckpt_dir = cfg.model_file + ".ckpt"
+
+    def slot_keys(payload):
+        return set(np.frombuffer(
+            base64.b64decode(payload["state"]["slot_keys"]),
+            np.int64).tolist())
+
+    def slot_rows(payload):
+        return np.frombuffer(
+            base64.b64decode(payload["state"]["slot_rows"]), np.int32)
+
+    # Run 1: SIGTERM after 20 steps — mid-era-B (shard 0 is 13
+    # batches, so the era-A admission barrier has run inside the
+    # first arrival gap), leaving shard 2 for the resumed run.
+    w.start()
+    with preempt_after_steps(20) as st:
+        train(cfg)
+    assert st["fired"], "SIGTERM injector never fired"
+    assert _verdict(cfg) == "PREEMPTED", _verdict(cfg)
+    w.join(timeout=120)
+    assert not w.is_alive(), "stream writer never finished"
+    assert len(distinct) >= 10 * V, len(distinct)
+    steps = list_step_dirs(ckpt_dir)
+    assert len(steps) >= 2, steps
+    newest = steps[-1]
+    payload = read_vocab_sidecar(ckpt_dir, newest)
+    assert payload is not None and payload_crc_ok(payload)
+    # Era A was admitted at SOME barrier before the preemption — pinned
+    # via the cumulative counter, NOT membership in the newest sidecar:
+    # barriers ride the wall-clock publish cadence, so on a fast machine
+    # several fire inside the first arrival gap and era A can be
+    # admitted AND already decayed out again by the step-20 save (that
+    # early eviction is correct behavior, not a miss).
+    c1 = _counters(cfg)
+    assert c1.get("vocab/admitted_rows", 0) >= len(era_a), (
+        f"expected >= {len(era_a)} admissions before the preemption, "
+        f"got {c1.get('vocab/admitted_rows', 0)}")
+    # Bit-exact round trip of the admission state through the sidecar
+    # machinery: payload -> runtime.load -> state_payload identity.
+    rt = VocabRuntime.from_config(cfg)
+    rt.load(cfg, payload)
+    assert rt.state_payload() == payload, (
+        "vocab admission payload does not round-trip bit-exactly")
+    # The walk-back fault: tear the newest step's largest array file —
+    # the resume must quarantine it and load the OLDER step's sidecar.
+    victim = truncate_checkpoint(cfg.model_file, seed=seed)
+    assert victim and f"{os.sep}{newest}{os.sep}" in victim, victim
+    older = steps[-2]
+    older_payload = read_vocab_sidecar(ckpt_dir, older)
+    assert older_payload is not None
+    older_live = len(slot_keys(older_payload))
+    # Run 2: resume through the walk-back, consume the rest of the
+    # stream (era B + tail), evicting era A as its estimate decays.
+    train(cfg)
+    log = open(cfg.log_file).read()
+    assert f"restored checkpoint at step {older}" in log, (
+        "resume did not walk back to the older step")
+    assert (f"restored vocab admission state at step {older}: "
+            f"{older_live} live rows") in log, (
+        "resume did not load the walked-back step's OWN vocab sidecar")
+    assert any(n.startswith(QUARANTINE_PREFIX)
+               for n in os.listdir(ckpt_dir))
+    c = _counters(cfg)
+    assert c.get("checkpoint/fallbacks", 0) >= 1, c
+    # Final published state: bounded table, era A evicted.
+    pub = read_published(ckpt_dir)
+    assert pub is not None
+    final_payload = read_vocab_sidecar(ckpt_dir, pub)
+    assert final_payload is not None and payload_crc_ok(final_payload)
+    rows = slot_rows(final_payload)
+    assert len(rows) <= V - 1, len(rows)
+    assert rows.size == 0 or (rows.min() >= 1 and rows.max() < V), rows
+    assert c.get("vocab/evicted_rows", 0) >= 1, c
+    final_keys = slot_keys(final_payload)
+    evicted_a = [s for s in era_a
+                 if murmur64(s.encode()) % HASH_SPACE not in final_keys]
+    assert evicted_a, (
+        "era-A ids all survived to the published step; eviction never "
+        "reclaimed their rows")
+    # Cold-row semantics at the published step: an EVICTED id scores
+    # bit-identically to a never-seen id (both route to the shared
+    # cold row) — never through its stale pre-eviction embedding.
+    import dataclasses
+    from fast_tffm_tpu.predict import load_table, predict_scores
+    from fast_tffm_tpu.vocab.table import VocabMap
+    pcfg = dataclasses.replace(cfg, run_mode="epochs", stream_dir="",
+                               train_files=())
+    table = load_table(pcfg, step=pub)
+    vmap = VocabMap.from_payload(pcfg, final_payload)
+    probe = os.path.join(workdir, "probe.txt")
+    with open(probe, "w") as fh:
+        fh.write(f"0 {evicted_a[0]}:1\n0 never_seen_xyzzy:1\n")
+    s = predict_scores(pcfg, table, (probe,), vocab=vmap)
+    assert s.shape == (2,)
+    assert s[0] == s[1], (
+        f"evicted id scored {s[0]} but the cold row scores {s[1]}: "
+        "the published step is serving a stale embedding")
+    return (f"{len(distinct)} distinct hashed ids (>= 10x the {V}-row "
+            f"table) streamed through SIGTERM+resume and a walk-back "
+            f"to step {older}; admission state round-tripped "
+            f"bit-exactly, {int(c.get('vocab/evicted_rows', 0))} rows "
+            f"evicted, published step {pub} serves evicted era-A ids "
+            "from the cold row")
+
+
 # --- multi-worker compute-plane scenarios --------------------------------
 
 
@@ -1210,6 +1398,7 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "preempt-resume": scenario_preempt_resume,
     "stream-soak": scenario_stream_soak,
     "stream-truncate": scenario_stream_truncate,
+    "vocab-churn": scenario_vocab_churn,
     "truncate-latest": scenario_truncate_latest,
     "kill-async-save": scenario_kill_async_save,
     "kill-worker-midwindow": scenario_kill_worker_midwindow,

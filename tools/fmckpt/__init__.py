@@ -47,7 +47,8 @@ from typing import Dict, List, Optional
 from fast_tffm_tpu.checkpoint import (QUARANTINE_PREFIX, list_step_dirs,
                                       read_epoch_override, read_manifest,
                                       read_published, sidecar_step,
-                                      verify_step_dir, watermark_path)
+                                      verify_step_dir,
+                                      vocab_sidecar_path, watermark_path)
 
 
 def resolve_ckpt_dir(path: str) -> str:
@@ -101,6 +102,11 @@ def scan(directory: str) -> Dict[str, object]:
             # just for a flag would make a plain ls read (and warn on)
             # payloads it doesn't need.
             "watermark": os.path.exists(watermark_path(directory, s)),
+            # Admit-mode runs leave a vocab admission sidecar per step
+            # (slot map + sketch); ls flags which steps carry one —
+            # existence only, like the watermark (verify owns the crc).
+            "vocab_sidecar": os.path.exists(
+                vocab_sidecar_path(directory, s)),
         })
     quarantined: List[Dict[str, object]] = []
     orphans: List[str] = []
@@ -152,6 +158,8 @@ def cmd_ls(directory: str, as_json: bool = False, out=None) -> int:
         marks = ""
         if s.get("watermark"):
             marks += " +watermark"
+        if s.get("vocab_sidecar"):
+            marks += " +VOCAB"
         if state.get("published") == s["step"]:
             marks += "  PUBLISHED"
         out.write(f"  step {s['step']:<10} {s['files']:>4} files "
@@ -170,6 +178,23 @@ def cmd_ls(directory: str, as_json: bool = False, out=None) -> int:
     for o in state["orphans"]:
         out.write(f"  {o}  ORPHANED sidecar (its step is gone)\n")
     return 0
+
+
+def _verify_vocab_sidecar(directory: str, step: int):
+    """(note, failed) for a step's vocab admission sidecar: absent ->
+    ("", False); readable with a matching embedded crc32 -> a "+vocab
+    crc OK" note; unreadable gzip/json or a crc mismatch -> a FAIL
+    reason (an admit-mode resume/serve load would otherwise fall back
+    to fresh admission state — the operator should know the sidecar is
+    torn BEFORE pointing a scorer at the step). The decision itself is
+    checkpoint.load_vocab_sidecar — the ONE reader restore shares."""
+    from fast_tffm_tpu.checkpoint import load_vocab_sidecar
+    payload, reason = load_vocab_sidecar(directory, step)
+    if reason is not None:
+        return reason, True
+    if payload is None:
+        return "", False  # absent (every fixed-mode step)
+    return ", +vocab crc OK", False
 
 
 def cmd_verify(directory: str, mode: str = "full",
@@ -196,14 +221,21 @@ def cmd_verify(directory: str, mode: str = "full",
             man = read_manifest(directory, s)
         except ValueError:
             man = "garbled"
+        vocab_note, vocab_fail = _verify_vocab_sidecar(directory, s)
         if man is None:
             out.write(f"step {s}: UNVERIFIABLE (predates manifests; "
                       "restore accepts it as-is)\n")
+            if vocab_fail:
+                failures += 1
+                out.write(f"step {s}: FAIL — {vocab_note}\n")
             continue
         reason = verify_step_dir(directory, s, mode)
+        if reason is None and vocab_fail:
+            reason = vocab_note
         if reason is None:
             n = len(man["files"]) if isinstance(man, dict) else "?"
-            out.write(f"step {s}: OK ({mode} check, {n} files)\n")
+            out.write(f"step {s}: OK ({mode} check, {n} files"
+                      f"{vocab_note})\n")
         else:
             failures += 1
             out.write(f"step {s}: FAIL — {reason}\n")
@@ -231,6 +263,13 @@ def cmd_publish(directory: str, step: int, mode: str = "size",
                   "untouched\n")
         return 1
     reason = verify_step_dir(directory, step, mode)
+    if reason is None:
+        # A torn vocab sidecar fails the publish too: every admit-mode
+        # reload of the step would raise (the fleet serves stale
+        # forever) — same gate cmd_verify applies.
+        vocab_note, vocab_fail = _verify_vocab_sidecar(directory, step)
+        if vocab_fail:
+            reason = vocab_note
     if reason is not None:
         out.write(f"step {step}: FAIL — {reason}; pointer untouched\n")
         return 1

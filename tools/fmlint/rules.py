@@ -253,6 +253,62 @@ def r006_unguarded_collective(path: str, tree: ast.AST) -> List[Finding]:
     return found
 
 
+# R011 scope: every linted module EXCEPT the two that ARE the
+# embedding-storage seam — lookup.py (the backend gather/apply/reset
+# surface) and the vocab/ package (the slot map itself).
+R011_EXEMPT_SUFFIXES = ("fast_tffm_tpu/lookup.py",)
+R011_EXEMPT_FRAGMENTS = ("fast_tffm_tpu/vocab/",)
+
+
+def r011_raw_table_index(path: str, tree: ast.AST) -> List[Finding]:
+    """Direct integer indexing into the embedding table (``table[ids]``
+    or ``x.table[ids]``) outside lookup.py/vocab/: with ``vocab_mode =
+    admit`` every id must route through the slot-indirection seam
+    (vocab.VocabMap.remap / a lookup backend's gather) — a raw gather
+    on unmapped ids is how eviction bugs are born: it reads rows the
+    slot map may have reassigned or reset. Plain slices
+    (``table[:n]``, checkpoint layout trims) are fine — they address
+    LAYOUT, not ids. The jitted math that runs BELOW the seam (the
+    batch reaching it is already physical-space) carries the usual
+    justified pragma."""
+    p = path.replace("\\", "/")
+    if (p.endswith(R011_EXEMPT_SUFFIXES)
+            or any(frag in p for frag in R011_EXEMPT_FRAGMENTS)):
+        return []
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        v = node.value
+        named_table = ((isinstance(v, ast.Name) and v.id == "table")
+                       or (isinstance(v, ast.Attribute)
+                           and v.attr == "table"))
+        if not named_table:
+            continue
+        def _layout(e) -> bool:
+            # Slices and fixed rows address LAYOUT, not id routing.
+            # Negative constants (table[-1], the dead tail row) parse
+            # as UnaryOp(USub, Constant), not Constant.
+            return (isinstance(e, (ast.Slice, ast.Constant))
+                    or (isinstance(e, ast.UnaryOp)
+                        and isinstance(e.op, ast.USub)
+                        and isinstance(e.operand, ast.Constant)))
+
+        sl = node.slice
+        if _layout(sl):
+            continue
+        if isinstance(sl, ast.Tuple) and all(_layout(e)
+                                             for e in sl.elts):
+            continue
+        found.append(Finding(
+            "R011", path, node.lineno,
+            "direct indexing into the embedding table bypasses the "
+            "slot-indirection seam (vocab_mode = admit remaps ids to "
+            "physical rows); gather through lookup.py / remap through "
+            "vocab.VocabMap, or justify with a pragma"))
+    return found
+
+
 RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter,
          r004_swallowed_exception, r005_ckpt_delete,
-         r006_unguarded_collective)
+         r006_unguarded_collective, r011_raw_table_index)

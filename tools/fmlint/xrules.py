@@ -264,8 +264,8 @@ def r008_unsynchronized_shared_mutation(proj: Project) -> List[Finding]:
 # --- R009: config/knob drift ----------------------------------------------
 
 _SECTION_BY_DICT = {"_GENERAL_KEYS": "General", "_TRAIN_KEYS": "Train",
-                    "_PREDICT_KEYS": "Predict", "_SERVE_KEYS": "Serve",
-                    "_CLUSTER_KEYS": "Cluster"}
+                    "_VOCAB_KEYS": "Vocab", "_PREDICT_KEYS": "Predict",
+                    "_SERVE_KEYS": "Serve", "_CLUSTER_KEYS": "Cluster"}
 
 
 def _config_schema(mod) -> Tuple[Dict[str, Dict[str, int]], Set[str]]:
