@@ -12,7 +12,7 @@
 #                   scorer trials + its host_threads 1/2/4 sweep
 #   make bench-vocab    admission-path overhead: train e2e at
 #                   vocab_mode=admit vs fixed (target <= 5% cost)
-#   make lint       fmlint whole-program pass (R000-R011) over
+#   make lint       fmlint whole-program pass (R000-R012) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
 #   make stream-soak  the streaming run-mode scenarios standalone
@@ -23,6 +23,9 @@
 #   make serve-soak the serving chaos scenario standalone (concurrent
 #                   requests across a hot reload, bit-identical to
 #                   batch predict)
+#   make slo-soak   the closed-loop SLO scenario standalone: gated
+#                   stream trainer + live writer + concurrent serving
+#                   + a poisoned burst the publish gate must catch
 #   make clean
 
 CXX ?= g++
@@ -66,7 +69,10 @@ serve: $(SO)
 serve-soak: $(SO)
 	JAX_PLATFORMS=cpu python -m tools.fmchaos serve-soak
 
+slo-soak: $(SO)
+	JAX_PLATFORMS=cpu python -m tools.fmchaos slo-soak
+
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict bench-vocab lint chaos stream-soak serve serve-soak clean
+.PHONY: all test bench bench-host bench-predict bench-vocab lint chaos stream-soak serve serve-soak slo-soak clean

@@ -608,6 +608,38 @@ def run_serve_latency(tmp):
     }
 
 
+def run_quality_eval_cost(cfg):
+    """The per-publish quality loop's cost line (README "SLOs & quality
+    gate"): one full validation sweep through train.evaluate WITH the
+    QualityStats collector vs without, on the headline corpus shape.
+    The collector rides the sweep's own score fetches, so the ratio is
+    the whole claim — near 1.0 means the gate's quality numbers are
+    effectively free on top of a validation pass the publish settle was
+    going to pay anyway. Returns (plain ex/s, collected ex/s, one
+    collected-sweep seconds)."""
+    from fast_tffm_tpu.models.fm import init_table
+    from fast_tffm_tpu.obs.quality import QualityStats
+    from fast_tffm_tpu.train import evaluate
+    table = init_table(cfg, cfg.seed)
+    # untimed warmup: compile the scorer once
+    evaluate(cfg, table, cfg.train_files, max_batches=2)
+
+    def sweep(with_stats):
+        stats = QualityStats(cfg.loss_type) if with_stats else None
+        t0 = time.perf_counter()
+        _auc, n = evaluate(cfg, table, cfg.train_files, collect=stats)
+        dt = time.perf_counter() - t0
+        if with_stats:
+            assert stats.loss is not None  # the collector really ran
+        return n / dt, dt
+
+    plain = statistics.median(sweep(False)[0] for _ in range(TRIALS))
+    pairs = [sweep(True) for _ in range(TRIALS)]
+    collected = statistics.median(r for r, _ in pairs)
+    secs = statistics.median(dt for _, dt in pairs)
+    return plain, collected, secs
+
+
 def _make_bench_telemetry(cfg):
     """Optional run-telemetry stream (obs/) for the bench: set
     FM_METRICS_FILE to write the same JSONL schema production train/
@@ -737,6 +769,16 @@ def main():
                   f"recording null", file=sys.stderr)
             serve_res = None
 
+        # Quality-loop eval cost (ISSUE 13): the publish gate's
+        # validation sweep with vs without the QualityStats collector.
+        try:
+            quality_res = run_quality_eval_cost(cfg)
+        except Exception as e:  # noqa: BLE001 - artifact survival
+            import sys
+            print(f"bench quality line failed ({type(e).__name__}: "
+                  f"{e}); recording null", file=sys.stderr)
+            quality_res = None
+
     def med(trials):  # None survives a timed-out line (see _isolated_line)
         return round(statistics.median(trials), 1) if trials else None
 
@@ -802,6 +844,20 @@ def main():
             serve_res["requests_per_sec"] if serve_res else None,
         "serve_examples_per_sec":
             serve_res["examples_per_sec"] if serve_res else None,
+        # The per-publish quality loop's cost (README "SLOs & quality
+        # gate"): eval sweep rate with the QualityStats collector
+        # riding the fetches vs the plain validation sweep, and the
+        # one-sweep wall the publish settle pays. Ratio ~1.0 = the
+        # gate's quality numbers are free on top of validation.
+        "quality_eval_examples_per_sec":
+            round(quality_res[1], 1) if quality_res else None,
+        "quality_eval_plain_examples_per_sec":
+            round(quality_res[0], 1) if quality_res else None,
+        "quality_vs_plain_eval_ratio":
+            round(quality_res[1] / quality_res[0], 4)
+            if quality_res and quality_res[0] else None,
+        "quality_eval_sweep_seconds":
+            round(quality_res[2], 3) if quality_res else None,
         "k16_e2e": med(k16),
         "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
         "l64_e2e": med(l64),

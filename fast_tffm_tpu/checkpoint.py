@@ -24,6 +24,7 @@ manifest existed carry nothing to verify against and stay restorable.
 from __future__ import annotations
 
 import json
+import math
 import os
 import re
 import threading
@@ -303,6 +304,38 @@ def write_published(directory: str, step: int) -> str:
     path = os.path.join(directory, PUBLISHED_POINTER)
     _atomic_write_text(path, f"{int(step)}\n")
     return path
+
+
+# Sidecar of the published pointer: the validation AUC of the last
+# SUCCESSFUL publish — the publish gate's drop baseline
+# (obs/quality.PublishGate). It describes the POINTER (not a step), so
+# it lives beside it, survives step GC like it, and a resumed trainer
+# re-arms publish_max_auc_drop from it instead of exempting the first
+# post-restart publish.
+GATE_BASELINE = "gate_baseline"
+
+
+def read_gate_baseline(directory: str) -> Optional[float]:
+    """The persisted drop baseline, or None (never published through a
+    gate / unreadable / garbled — the gate then starts baseline-free,
+    exactly like a first publish)."""
+    try:
+        # fmlint: disable=R010 -- trainer-startup read: absent is the
+        # normal no-gated-publish-yet state; any flake degrades to a
+        # baseline-free (first-publish) gate, never a crash
+        with open(os.path.join(directory, GATE_BASELINE),
+                  encoding="utf-8") as fh:
+            v = float(fh.read().strip())
+        return v if math.isfinite(v) else None
+    except (OSError, ValueError):
+        return None
+
+
+def write_gate_baseline(directory: str, auc: float) -> None:
+    """Atomically persist the drop baseline beside the pointer (same
+    tmp+fsync+rename sequence, same torn-read-free contract)."""
+    _atomic_write_text(os.path.join(directory, GATE_BASELINE),
+                       f"{float(auc):.10f}\n")
 
 
 def wait_for_published(directory: str, last: Optional[int] = None,
@@ -831,15 +864,19 @@ class CheckpointState:
             mode, path)
         return path
 
-    def published_at_risk(self) -> bool:
+    def published_at_risk(self, margin: int = 1) -> bool:
         """Whether retention is about to lap the ``published`` pointer:
-        True when the pointed-at step is gone already, or one more
-        periodic save would GC it (max_to_keep newest-N eviction). The
+        True when the pointed-at step is gone already, or ``margin``
+        more saves would GC it (max_to_keep newest-N eviction). The
         stream driver republishes FIRST when this fires, so the
         pointer a scorer resolves never names a deleted step — frequent
         ``save_steps`` saves under a long ``publish_interval_seconds``
         would otherwise delete the published checkpoint out from under
-        the serving fleet mid-interval."""
+        the serving fleet mid-interval. ``margin=2`` is the publish
+        gate's retention-pause threshold: while a hold blocks
+        republishing, periodic saves stop one slot EARLY so the
+        mandatory final/preemption save can still land without
+        evicting the last-good step."""
         pub = read_published(self.directory)
         if pub is None:
             return False
@@ -847,7 +884,7 @@ class CheckpointState:
         if pub not in steps:
             return True  # already dangling: republish immediately
         newer = sum(1 for s in steps if s > pub)
-        return newer >= self._max_to_keep - 1
+        return newer >= self._max_to_keep - margin
 
     # -- integrity: verify / quarantine / step decision -----------------
 

@@ -210,6 +210,47 @@ class FmConfig:
     # a serving process can watch (fmckpt ls shows it). 0 = no
     # publishing (periodic save_steps saves still apply).
     publish_interval_seconds: float = 0.0
+    # Per-publish quality gate (README "SLOs & quality gate";
+    # obs/quality.py). With ``validation_files`` set on a stream run,
+    # every publish settle runs a validation sweep (AUC + loss +
+    # calibration ride the same score fetches — zero extra device
+    # traffic) and these thresholds decide whether the ``published``
+    # pointer may move: a regressed model NEVER reaches serving — the
+    # pointer stays on the last passing step, a ``health: gate_held``
+    # event fires, and fmstat's verdict reads GATE-HELD.
+    # publish_min_auc: absolute floor — hold the publish when the
+    # sweep's AUC is below this (also the only check on the very first
+    # publish, when no prior published AUC exists). 0 = off.
+    publish_min_auc: float = 0.0
+    # publish_max_auc_drop: relative guard — hold when AUC fell more
+    # than this below the AUC of the last SUCCESSFUL publish. 0 = off.
+    publish_max_auc_drop: float = 0.0
+    # Whether the per-publish validation sweep runs at all. "auto"
+    # (default) enables it exactly when the run declared a quality
+    # objective — a gate knob above, or slo_min_auc — so a pre-existing
+    # stream config with validation_files pays NO new per-publish cost
+    # until it opts into quality observability; "on" forces the sweep
+    # (gauges without a gate); "off" disables it (rejected when a gate
+    # is configured — the gate's decision IS the sweep).
+    publish_quality_eval: str = "auto"  # "auto" | "on" | "off"
+
+    # --- [SLO] -------------------------------------------------------------
+    # Declarative service-level objectives (README "SLOs & quality
+    # gate"; obs/slo.py). Each knob declares one objective over the
+    # metrics stream; 0 (the default) leaves that objective unset. The
+    # configured spec is stamped into the run's metrics as ``slo/*``
+    # gauges, so ``python -m tools.fmstat slo <metrics.jsonl>`` renders
+    # the per-objective PASS/FAIL table from the JSONL alone — the one
+    # operator answer to "is this deployment healthy".
+    # Freshness: the last published checkpoint must be at most this
+    # many seconds old at the final metrics flush.
+    slo_publish_staleness_seconds: float = 0.0
+    # Latency: the serving request-latency p99 must be at most this.
+    slo_p99_ms: float = 0.0
+    # Quality: the latest quality/validation AUC must be at least this.
+    slo_min_auc: float = 0.0
+    # Input health: bad lines / scanned lines must be at most this.
+    slo_max_bad_fraction: float = 0.0
 
     # --- [Vocab] -----------------------------------------------------------
     # Unbounded-vocabulary admission (README "Unbounded vocabulary";
@@ -454,6 +495,68 @@ class FmConfig:
                 "stream_dir is set but run_mode is 'epochs'; set "
                 "run_mode = stream (or drop stream_dir) — a silently "
                 "ignored stream directory is always a config mistake")
+        for knob in ("publish_min_auc", "publish_max_auc_drop"):
+            v = getattr(self, knob)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(
+                    f"{knob} must be in [0, 1] (0 = gate check off), "
+                    f"got {v}")
+        if self.publish_min_auc or self.publish_max_auc_drop:
+            # The gate evaluates a validation sweep at publish settles;
+            # without a corpus to sweep (or publishes to gate) the
+            # knobs would be silently inert — always a config mistake.
+            if self.run_mode != "stream":
+                raise ValueError(
+                    "publish_min_auc/publish_max_auc_drop gate stream-"
+                    "mode publishes; set run_mode = stream (epoch-mode "
+                    "runs never publish, so the gate would silently "
+                    "never run)")
+            if not self.validation_files:
+                raise ValueError(
+                    "publish_min_auc/publish_max_auc_drop need "
+                    "validation_files: the gate's decision IS a "
+                    "validation sweep at each publish settle")
+            if self.publish_interval_seconds <= 0:
+                raise ValueError(
+                    "publish_min_auc/publish_max_auc_drop need "
+                    "publish_interval_seconds > 0: the gate rides "
+                    "publish settles, and a never-publishing stream "
+                    "has nothing to gate")
+        if self.publish_quality_eval not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown publish_quality_eval "
+                f"{self.publish_quality_eval!r} (want auto | on | off)")
+        if (self.publish_quality_eval == "off"
+                and (self.publish_min_auc or self.publish_max_auc_drop)):
+            raise ValueError(
+                "publish_quality_eval = off conflicts with the publish "
+                "gate knobs: the gate's decision IS the per-publish "
+                "validation sweep")
+        if self.publish_quality_eval == "on":
+            if self.run_mode != "stream" or not self.validation_files \
+                    or self.publish_interval_seconds <= 0:
+                raise ValueError(
+                    "publish_quality_eval = on needs run_mode = "
+                    "stream, validation_files, and "
+                    "publish_interval_seconds > 0: the sweep runs at "
+                    "publish settles over the validation corpus")
+        if self.slo_publish_staleness_seconds < 0:
+            raise ValueError(
+                f"slo_publish_staleness_seconds must be >= 0 (0 = "
+                f"objective unset), got "
+                f"{self.slo_publish_staleness_seconds}")
+        if self.slo_p99_ms < 0:
+            raise ValueError(
+                f"slo_p99_ms must be >= 0 (0 = objective unset), got "
+                f"{self.slo_p99_ms}")
+        if not 0.0 <= self.slo_min_auc <= 1.0:
+            raise ValueError(
+                f"slo_min_auc must be in [0, 1] (0 = objective unset), "
+                f"got {self.slo_min_auc}")
+        if not 0.0 <= self.slo_max_bad_fraction <= 1.0:
+            raise ValueError(
+                f"slo_max_bad_fraction must be in [0, 1] (0 = "
+                f"objective unset), got {self.slo_max_bad_fraction}")
         if self.vocab_mode not in ("fixed", "admit"):
             raise ValueError(
                 f"unknown vocab_mode {self.vocab_mode!r} "
@@ -630,6 +733,15 @@ _TRAIN_KEYS = {
     "stream_poll_seconds": float,
     "seal_policy": str,
     "publish_interval_seconds": float,
+    "publish_min_auc": float,
+    "publish_max_auc_drop": float,
+    "publish_quality_eval": str,
+}
+_SLO_KEYS = {
+    "slo_publish_staleness_seconds": float,
+    "slo_p99_ms": float,
+    "slo_min_auc": float,
+    "slo_max_bad_fraction": float,
 }
 _VOCAB_KEYS = {
     "vocab_mode": str,
@@ -674,8 +786,9 @@ def load_config(path: str) -> FmConfig:
     # The one section->keys mapping: drives both the consume loop and
     # the wrong-section hint, so the two cannot diverge.
     sections = {"General": _GENERAL_KEYS, "Train": _TRAIN_KEYS,
-                "Vocab": _VOCAB_KEYS, "Predict": _PREDICT_KEYS,
-                "Serve": _SERVE_KEYS, "Cluster": _CLUSTER_KEYS}
+                "SLO": _SLO_KEYS, "Vocab": _VOCAB_KEYS,
+                "Predict": _PREDICT_KEYS, "Serve": _SERVE_KEYS,
+                "Cluster": _CLUSTER_KEYS}
 
     def consume(section: str, keys):
         if not cp.has_section(section):

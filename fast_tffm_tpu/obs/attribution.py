@@ -218,6 +218,16 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
                                           0),
         "vocab_live_rows": g.get("vocab/live_rows"),
         "vocab_sketch_fill": g.get("vocab/sketch_fill"),
+        # Per-publish quality loop + gate (README "SLOs & quality
+        # gate"; obs/quality.py): sweep count/cost, the latest quality
+        # gauges, and how often the gate held the published pointer.
+        "quality_evals": c.get("quality/evals", 0),
+        "quality_eval_seconds": c.get("quality/eval_seconds", 0.0),
+        "quality_examples": c.get("quality/examples", 0),
+        "quality_gate_held": c.get("quality/gate_held", 0),
+        "quality_auc": g.get("quality/auc"),
+        "quality_loss": g.get("quality/loss"),
+        "quality_calibration": g.get("quality/calibration"),
     }
 
     # Serving (README "Serving"; fast_tffm_tpu/serve/): request/latency
@@ -393,6 +403,19 @@ def _bench_verdict(ceil: Dict[str, float]) -> str:
             f"({v:,.0f} ex/s)")
 
 
+# Every `health: <kind>` event the codebase can emit, by status
+# string. This is the read-side catalog: health_verdict maps each kind
+# into a verdict or a detail note below, the README's health-event
+# table documents each row, and fmlint R012 gates all three against
+# the emit sites — a new health kind cannot land without its fmstat
+# mapping and its catalog row.
+HEALTH_KINDS = frozenset({
+    "stalled", "recovered", "nonfinite_loss", "preempted",
+    "worker_lost", "elastic_recovered", "ckpt_fallback", "bad_input",
+    "collective_slow", "cluster_bringup_failed", "gate_held",
+})
+
+
 def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
     """The run-health verdict line for one merged summary (obs/health):
     ``{"verdict": "OK" | "PREEMPTED" | "DEGRADED (N workers lost)" |
@@ -425,12 +448,32 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                    if h.get("status") == "worker_lost"]
     elastic = [h for h in health
                if h.get("status") == "elastic_recovered"]
+    holds = [h for h in health if h.get("status") == "gate_held"]
+    bad_inputs = [h for h in health if h.get("status") == "bad_input"]
+    slow = [h for h in health
+            if h.get("status") == "collective_slow"]
+    bringup = [h for h in health
+               if h.get("status") == "cluster_bringup_failed"]
     unclosed = (summary.get("run_starts", 0)
                 > summary.get("run_ends", 0))
     notes = []
     if unclosed:
         notes.append("stream has no run_end (hard kill, still "
                      "running, or a lost worker's shard)")
+    if bad_inputs:
+        notes.append(f"{len(bad_inputs)} bad_input episode(s) — lines "
+                     "skipped/quarantined under bad_line_policy")
+    if slow:
+        notes.append(f"{len(slow)} collective_slow episode(s) — the "
+                     "cluster was healthy but slow at a deadline")
+    if bringup:
+        notes.append("cluster bring-up exhausted its retry budget "
+                     "(cluster_bringup_failed)")
+    unknown = sorted({str(h.get("status", "")) for h in health}
+                     - HEALTH_KINDS - {""})
+    if unknown:
+        notes.append(f"unrecognized health kind(s): "
+                     f"{', '.join(unknown)} — update fmstat's catalog")
     if crashes:
         first = crashes[0]
         err = str(first.get("error", "?"))
@@ -486,6 +529,22 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                     [f"{len(stalls)} stall episode(s), worst "
                      f"{worst:.1f}s without progress{rec}; stacks: "
                      f"{stalls[0].get('stacks_file', '?')}"] + notes)}
+    if holds:
+        # Ranked below STALLED (the run itself is healthy — its DATA
+        # or MODEL regressed) and above STALE PUBLISH (a long hold is
+        # the usual cause of one; name the cause, not the symptom).
+        last = holds[-1]
+        why = "; ".join(last.get("reasons") or []) or \
+            "validation quality regressed"
+        return {"verdict": f"GATE-HELD (x{len(holds)})",
+                "detail": "; ".join(
+                    [f"publish gate held the pointer {len(holds)} "
+                     f"time(s), last at step {last.get('step', '?')} "
+                     f"(AUC {_fmt(last.get('auc'))}): {why}. Serving "
+                     "continues on the last passing step; inspect the "
+                     "input burst (quarantine sidecar, quality/auc "
+                     "timeline) — publishes resume when validation "
+                     "recovers"] + notes)}
     stale = stale_publish(summary)
     if stale is not None:
         # Checked BEFORE the unclosed-stream heuristic: a live stream
@@ -528,6 +587,9 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                      f"{steps} and fell back to an older step — the "
                      f"run then completed cleanly{where}; reclaim "
                      "space with `python -m tools.fmckpt gc`"] + notes)}
+    if notes:
+        return {"verdict": "OK",
+                "detail": "; ".join(["run_end present"] + notes)}
     return {"verdict": "OK", "detail": "no health/crash events; "
             "run_end present"}
 
@@ -716,6 +778,22 @@ def render(summary: Dict[str, Any]) -> str:
                  f"{_fmt(age)} / {_fmt(interval)}"),
         ):
             lines.append(f"    {k:<32} {v}")
+    if att["quality_evals"] or att["quality_gate_held"]:
+        lines.append("  QUALITY (per-publish eval + gate):")
+        evs = att["quality_evals"]
+        secs = att["quality_eval_seconds"]
+        per = (secs / evs) if evs else None
+        for k, v in (
+                ("quality AUC (latest)", att["quality_auc"]),
+                ("quality loss (latest)", att["quality_loss"]),
+                ("calibration (pred/label)",
+                 att["quality_calibration"]),
+                ("evals (examples swept)",
+                 f"{_fmt(evs)} ({_fmt(att['quality_examples'])})"),
+                ("eval cost (s/eval)", per),
+                ("publishes gate-held", att["quality_gate_held"]),
+        ):
+            lines.append(f"    {k:<32} {_fmt(v)}")
     if att["vocab_ids"] or att["vocab_live_rows"] is not None:
         lines.append("  VOCAB (vocab_mode = admit):")
         for k, v in (

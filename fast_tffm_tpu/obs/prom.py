@@ -1,0 +1,78 @@
+"""Prometheus text-exposition rendering of a registry snapshot.
+
+The serving front end's ``GET /metrics`` (README "Serving"): the obs
+registry's counters/gauges/histograms in the exposition format
+(version 0.0.4) a Prometheus scraper consumes directly — no JSONL
+parsing on the scrape path, no extra bookkeeping on the serve path
+(the snapshot is the same one /healthz reads). Dependency-free and
+jax-free like the registry itself.
+
+Naming: ``serve/request_latency_ms`` -> ``fm_serve_request_latency_ms``
+(slashes and other non-metric characters fold to ``_``; everything is
+prefixed ``fm_``). Histograms render the full convention — cumulative
+``_bucket{le=...}`` series from the registry's fixed upper bounds, an
+explicit ``+Inf`` bucket, ``_sum`` and ``_count`` — so quantiles are
+the scraper's ``histogram_quantile`` over exact bucket counts, not a
+re-quantization of our estimates.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict
+
+# Content-Type the HTTP front end serves this under.
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def metric_name(name: str, prefix: str = "fm_") -> str:
+    """A registry metric name as a legal Prometheus metric name."""
+    return prefix + _NAME_BAD.sub("_", name)
+
+
+def _num(v: float) -> str:
+    """Exposition-format number: integers bare, floats via repr
+    (shortest round-trip), non-finite as Prometheus spells them."""
+    v = float(v)
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v.is_integer() and abs(v) < 2 ** 53:
+        return str(int(v))
+    return repr(v)
+
+
+def prometheus_text(snapshot: Dict[str, Any],
+                    prefix: str = "fm_") -> str:
+    """One scrape body from a ``MetricsRegistry.snapshot()`` dict.
+    Deterministic (sorted names) so the format can be pinned by
+    tests."""
+    lines = []
+    for name, v in sorted((snapshot.get("counters") or {}).items()):
+        m = metric_name(name, prefix)
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_num(v)}")
+    for name, v in sorted((snapshot.get("gauges") or {}).items()):
+        m = metric_name(name, prefix)
+        lines.append(f"# TYPE {m} gauge")
+        lines.append(f"{m} {_num(v)}")
+    for name, s in sorted((snapshot.get("hists") or {}).items()):
+        m = metric_name(name, prefix)
+        lines.append(f"# TYPE {m} histogram")
+        cum = 0
+        for bound, count in zip(s["bounds"], s["counts"]):
+            # fmlint: disable=R001 -- snapshot values are host
+            # ints/floats (the registry is jax-free by design)
+            cum += int(count)
+            lines.append(f'{m}_bucket{{le="{_num(bound)}"}} {cum}')
+        # fmlint: disable=R001 -- host snapshot value, never a device
+        # array (offline read side)
+        lines.append(f'{m}_bucket{{le="+Inf"}} {int(s["count"])}')
+        lines.append(f"{m}_sum {_num(s['sum'])}")
+        # fmlint: disable=R001 -- host snapshot value (see above)
+        lines.append(f"{m}_count {int(s['count'])}")
+    return "\n".join(lines) + "\n"

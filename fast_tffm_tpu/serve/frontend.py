@@ -13,6 +13,10 @@ framework, and a scorer's wire format is one float per input line):
                      bad request fails itself, never the process).
     GET  /healthz    JSON: served/published step, queue depth, request
                      counters, latency p50/p99, uptime.
+    GET  /metrics    the obs registry (counters / gauges / histogram
+                     buckets) in Prometheus text exposition format
+                     (obs/prom.py) — the scrape endpoint; no JSONL
+                     parsing needed to monitor a serving fleet.
 
 Threading: http.server's ThreadingHTTPServer gives each connection a
 thread; all of them funnel into the ScorerServer's admission queue,
@@ -92,9 +96,14 @@ class _Handler(BaseHTTPRequestHandler):
                     extra={"X-FM-Step": str(res.step)})
 
     def do_GET(self) -> None:  # noqa: N802 - http.server contract
+        if self.path == "/metrics":
+            from fast_tffm_tpu.obs.prom import PROM_CONTENT_TYPE
+            body = self.server.fm_server.metrics_text()
+            self._reply(200, body.encode("utf-8"), PROM_CONTENT_TYPE)
+            return
         if self.path != "/healthz":
-            self._reply(404, b"unknown path; GET /healthz\n",
-                        "text/plain")
+            self._reply(404, b"unknown path; GET /healthz or "
+                             b"/metrics\n", "text/plain")
             return
         stats = self.server.fm_server.stats()
         self._reply(200, (json.dumps(stats) + "\n").encode("utf-8"),

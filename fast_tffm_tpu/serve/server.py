@@ -188,6 +188,11 @@ class ScorerServer:
         self._tel = make_telemetry(cfg, "serve")
         self._reg = (self._tel.registry if self._tel is not None
                      else MetricsRegistry())
+        # Stamp the declared SLO spec into the serve stream too (the
+        # slo_p99_ms objective is measured HERE): `fmstat slo` over
+        # the serve metrics file then carries its own spec.
+        from fast_tffm_tpu.obs.slo import SloSpec
+        SloSpec.from_config(cfg).emit_gauges(self._reg)
         from fast_tffm_tpu.scoring import CompiledScorer
         self._scorer = CompiledScorer(cfg, dedup="device")
         # Unbounded vocabulary (vocab_mode = admit; README "Unbounded
@@ -564,6 +569,14 @@ class ScorerServer:
             "latency_p99_ms": lat.quantile(0.99),
             "uptime_seconds": time.time() - self._start_time,
         }
+
+    def metrics_text(self) -> str:
+        """The ``GET /metrics`` body: this server's registry in
+        Prometheus text exposition format (obs/prom.py) — scrapeable
+        without parsing JSONL, from the same snapshot /healthz
+        reads."""
+        from fast_tffm_tpu.obs.prom import prometheus_text
+        return prometheus_text(self._reg.snapshot())
 
     def close(self) -> None:
         """Drain and stop: no new submissions, every queued request

@@ -60,7 +60,7 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
              max_batches: Optional[int] = None,
              mesh=None, backend=None,
              weight_files=(), bad_lines=None,
-             vocab=None) -> Tuple[float, int]:
+             vocab=None, collect=None) -> Tuple[float, int]:
     """Streamed AUC over ``files``; returns (auc, n_examples). Pass the
     training mesh to score a row-sharded table in place, or a lookup
     ``backend`` (lookup.HostOffloadLookup) to score a host-offloaded
@@ -69,7 +69,11 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     same way training weights its loss. ``bad_lines``: the caller's
     run-scoped BadLineTracker — train() shares its tracker so
     per-epoch validation sweeps don't quarantine the same bad line
-    once per epoch through fresh dedupe sets."""
+    once per epoch through fresh dedupe sets. ``collect`` (an
+    obs/quality.QualityStats or anything with the same
+    ``update(scores, labels, weights)`` surface) is fed the SAME host
+    score chunks the AUC update consumes — the publish-gate quality
+    loop's zero-added-device-fetch seam."""
     spec = ModelSpec.from_config(cfg)
     score_fn = make_batch_scorer(spec, mesh=mesh, backend=backend)
     raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
@@ -82,11 +86,17 @@ def evaluate(cfg: FmConfig, table: jax.Array, files,
     auc = StreamingAUC()
     n = 0
     n_batches = 0
+
+    def _consume(scores, m):
+        s, y, w = scores[:m[1]], m[0][:m[1]], m[2][:m[1]]
+        auc.update(s, y, w)
+        if collect is not None:
+            collect.update(s, y, w)
+
     # Chunked fetches (utils/fetch.py): per-batch syncs are ruinous over
     # a tunnelled link, whole-sweep buffering is unbounded.
     fetcher = ChunkedFetcher(
-        lambda scores, m: auc.update(scores[:m[1]], m[0][:m[1]],
-                                     m[2][:m[1]]),
+        _consume,
         overlap=True)  # D2H of chunk N overlaps scoring of chunk N+1
     tel = active()
     # try/finally (ADVICE round 5): an exception mid-sweep must not
@@ -128,7 +138,7 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                          max_batches: Optional[int] = None,
                          weight_files=(),
                          bad_lines=None,
-                         preempt=None) -> Tuple[float, int]:
+                         preempt=None, collect=None) -> Tuple[float, int]:
     """Multi-process sharded AUC: every process scores its own input
     shard through the mesh score fn in lockstep (the shared
     lockstep_score_batches protocol), then the per-process binned-AUC
@@ -142,7 +152,13 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
     (parallel/sharded.py): a SIGTERM on one worker stops the sweep on
     EVERY worker at the same window boundary — the partial histograms
     still merge below (everyone exits the loop together, so the final
-    allgather stays matched)."""
+    allgather stays matched). ``collect`` (obs/quality.QualityStats):
+    fed the per-batch local scores like the AUC update, and its four
+    sums ride INSIDE the existing histogram-merge allgather payload —
+    the quality loop adds no collective and no device fetch; after the
+    merge the collector holds the job-wide totals. Its presence is
+    config-deterministic, so every process ships the same payload
+    width."""
     import numpy as np
     from jax.experimental import multihost_utils
     from fast_tffm_tpu.data.pipeline import probe_uniq_bucket
@@ -165,6 +181,9 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
                                                preempt=preempt):
         nr = batch.num_real
         auc.update(local[:nr], batch.labels[:nr], batch.weights[:nr])
+        if collect is not None:
+            collect.update(local[:nr], batch.labels[:nr],
+                           batch.weights[:nr])
         n += batch.num_real
     # process_allgather device_puts its payload and this runtime never
     # enables x64, so float64 histograms (and int64 counts) silently
@@ -174,21 +193,29 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
     # float32 pair (lo = v - f64(f32(v))): hi + lo recovers ~48 bits
     # exactly, enough for any count this side of 10^14.
     bins = auc.num_bins
+    # The quality collector's four sums ride the same payload (its
+    # presence is config-driven, so every process agrees on the
+    # width) — the publish-gate quality loop adds zero collectives.
+    extra = (collect.sums() if collect is not None
+             else np.zeros(0, np.float64))
     payload = np.concatenate([auc.pos, auc.neg,
-                              np.asarray([n], np.float64)])
+                              np.asarray([n], np.float64), extra])
+    width = 2 * bins + 1 + extra.shape[0]
     hi = payload.astype(np.float32)
     lo = (payload - hi.astype(np.float64)).astype(np.float32)
     gathered = guarded_collective(
         multihost_utils.process_allgather,
         np.stack([hi, lo]),
-        label="validation/auc_merge")          # [P, 2, 2*bins+1] f32
-    gathered = gathered.reshape(-1, 2, 2 * bins + 1)
+        label="validation/auc_merge")          # [P, 2, width] f32
+    gathered = gathered.reshape(-1, 2, width)
     vals = (gathered[:, 0, :].astype(np.float64)
             + gathered[:, 1, :].astype(np.float64)).sum(axis=0)
     merged = StreamingAUC(num_bins=bins)
     merged.pos[:] = vals[:bins]
     merged.neg[:] = vals[bins:2 * bins]
-    n_total = int(round(vals[-1]))
+    n_total = int(round(vals[2 * bins]))
+    if collect is not None:
+        collect.load_sums(vals[2 * bins + 1:])
     return merged.result(), n_total
 
 
@@ -233,6 +260,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             "writing run metrics to %s (flush every %s steps; summarize "
             "with: python -m tools.fmstat %s)", tel.sink.path,
             tel.flush_steps or "epoch", tel.sink.path)
+        # Stamp the configured SLO spec into the stream as slo/*
+        # gauges, so `fmstat slo` renders the PASS/FAIL table from the
+        # JSONL alone — no config file needed at read time (obs/slo.py).
+        from fast_tffm_tpu.obs.slo import SloSpec
+        SloSpec.from_config(cfg).emit_gauges(tel)
     # One run-scoped tracker (None under bad_line_policy = error): the
     # max_bad_fraction breaker and the quarantine dedupe must see the
     # WHOLE run — every epoch AND every elastic recovery
@@ -626,6 +658,136 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 "cold-started %d table rows for the fresh admission "
                 "state", cfg.vocabulary_size)
 
+        # Per-publish quality loop + publish gate (README "SLOs &
+        # quality gate"; obs/quality.py). When a stream run has a
+        # validation corpus, every publish settle runs one validation
+        # sweep — AUC/loss/calibration gauges ride the sweep's own
+        # score fetches (zero added device traffic) — and the
+        # configured gate decides whether the `published` pointer may
+        # move. The helper is session-scoped (not inside _run_stream)
+        # because the EXIT publish after the final save is gated too.
+        from fast_tffm_tpu.obs.quality import PublishGate
+        gate = PublishGate.from_config(cfg) if stream_mode else None
+        # "auto" opts in exactly when the run declared a quality
+        # objective (a gate knob, or slo_min_auc) — an existing stream
+        # config with validation_files must not silently start paying
+        # a validation sweep per publish on upgrade.
+        _qmode = getattr(cfg, "publish_quality_eval", "auto")
+        quality_on = (stream_mode and bool(cfg.validation_files)
+                      and float(getattr(cfg, "publish_interval_seconds",
+                                        0.0)) > 0
+                      and (_qmode == "on"
+                           or (_qmode == "auto"
+                               and (gate is not None
+                                    or getattr(cfg, "slo_min_auc",
+                                               0.0) > 0))))
+        if gate is not None:
+            # The drop baseline survives restarts beside the pointer
+            # (checkpoint.GATE_BASELINE): a preempt-resume must not
+            # exempt its first publish from publish_max_auc_drop.
+            from fast_tffm_tpu.checkpoint import read_gate_baseline
+            gate.note_published(read_gate_baseline(ckpt.directory))
+            logger.info(
+                "publish gate armed: min AUC %s, max AUC drop %s%s "
+                "(validation sweep at every publish settle)",
+                cfg.publish_min_auc or "off",
+                cfg.publish_max_auc_drop or "off",
+                "" if gate.baseline is None
+                else f", restored baseline {gate.baseline:.6f}")
+
+        def _gate_published(decision) -> None:
+            """Advance (and persist) the drop baseline after a publish
+            actually landed — the one baseline-write path for both the
+            interval publishes and the exit publish."""
+            if gate is None or decision is None:
+                return
+            gate.note_published(decision.get("auc"))
+            if gate.baseline is not None and jax.process_index() == 0:
+                from fast_tffm_tpu.checkpoint import write_gate_baseline
+                write_gate_baseline(ckpt.directory, gate.baseline)
+
+        def _publish_decision() -> Optional[dict]:
+            """Quality sweep + gate decision for the publish about to
+            happen; None when no quality loop is configured (publish
+            unconditionally). Rides the publish settle point the
+            caller already synchronized at; multi-host safe: the sweep
+            merge is collective and the chief's decision is broadcast
+            (obs/quality.PublishGate docstring), so all workers skip
+            or run the save/publish below together."""
+            if not quality_on:
+                return None
+            from fast_tffm_tpu.obs.quality import (QualityStats,
+                                                   emit_gate_held,
+                                                   emit_quality)
+            stats = QualityStats(cfg.loss_type)
+            vmb = cfg.validation_max_batches or None
+            # fmlint: disable=R003 -- feeds the quality/eval_seconds
+            # counter (the quality/eval span is the timeline view)
+            t_q = time.perf_counter()
+            with span("quality/eval", step=global_step):
+                if multi_process:
+                    # preempt rides the lockstep window allgather like
+                    # every other multi-process sweep: a SIGTERM mid-
+                    # sweep stops ALL workers at the same window
+                    # boundary instead of finishing the full
+                    # validation pass inside the kill grace window.
+                    auc, n = evaluate_distributed(
+                        cfg, table, cfg.validation_files, mesh,
+                        shard_index, num_shards,
+                        uniq_bucket=val_bucket, max_batches=vmb,
+                        weight_files=cfg.validation_weight_files,
+                        bad_lines=bad_tracker, collect=stats,
+                        preempt=lambda: bool(preempted))
+                else:
+                    auc, n = evaluate(
+                        cfg, table, cfg.validation_files, mesh=mesh,
+                        backend=lk, max_batches=vmb,
+                        weight_files=cfg.validation_weight_files,
+                        bad_lines=bad_tracker, vocab=vocab,
+                        collect=stats)
+            # fmlint: disable=R003 -- closes the eval-cost sample
+            dt_q = time.perf_counter() - t_q
+            if jax.process_index() == 0:
+                # Chief-only: n and the merged stats are already
+                # job-global, and per-worker shard counters merge by
+                # SUM in fmstat — every worker emitting would inflate
+                # quality/evals and quality/examples by P.
+                emit_quality(tel, global_step, float(auc), stats, n,
+                             dt_q)
+            if tel is not None:
+                tel.heartbeat()  # a long sweep is progress, not a stall
+            if jax.process_index() == 0:
+                logger.info(
+                    "publish quality eval at step %d: AUC %.6f, loss "
+                    "%s, calibration %s over %d examples (%.2fs)",
+                    global_step, auc,
+                    "-" if stats.loss is None
+                    else f"{stats.loss:.6f}",
+                    "-" if stats.calibration is None
+                    else f"{stats.calibration:.4f}", n, dt_q)
+            if gate is None:
+                return {"held": False, "auc": float(auc),
+                        "examples": int(n)}
+            # Chief decides, broadcast: identity single-process; every
+            # worker applies the byte-identical decision.
+            from fast_tffm_tpu.data.stream import broadcast_blob
+            decision = broadcast_blob(
+                gate.decide(float(auc), global_step),
+                "quality/gate_decision")
+            # n is already job-global (the sweep merge), so adding it
+            # after the broadcast stays identical on every worker.
+            decision["examples"] = int(n)
+            if decision["held"]:
+                if jax.process_index() == 0:
+                    # Chief-only, like emit_quality: one hold must
+                    # count once, not once per worker shard.
+                    emit_gate_held(tel, decision)
+                logger.warning(
+                    "publish GATE HELD at step %d: %s — the published "
+                    "pointer stays on the last passing step",
+                    global_step, "; ".join(decision["reasons"]))
+            return decision
+
         # Preemption handling (SURVEY §5 "Failure detection": the reference
         # only recovers via restart+restore; we additionally save on the way
         # down). SIGTERM/SIGINT sets a flag the loop drains at the next step
@@ -837,6 +999,24 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             publish_every = float(
                 getattr(cfg, "publish_interval_seconds", 0.0))
             last_publish = [time.monotonic()]
+            # The freshness gauge (and the STALE PUBLISH verdict) track
+            # the last SUCCESSFUL publish, separately from the attempt
+            # clock above: a gate that keeps holding advances the
+            # cadence but NOT the pointer — the age must keep growing
+            # so a long hold surfaces as STALE PUBLISH, the closed
+            # loop's designed failure signal.
+            last_publish_ok = [time.monotonic()]
+            # Whether the LAST gate decision held. While holding, the
+            # retention-pressure publish trigger below is disarmed: a
+            # republish attempt cannot succeed (the gate would hold
+            # the same regressed state again), so re-arming it would
+            # spin a full validation sweep per loop iteration for the
+            # whole hold. The interval arm keeps re-evaluating at the
+            # publish cadence — the bounded re-check that notices
+            # recovery.
+            gate_holding = [False]
+            # One retention-pause log per hold episode (see step_once).
+            risk_pause_logged = [False]
             if tel is not None:
                 tel.set("stream/publish_interval_seconds",
                         publish_every)
@@ -852,7 +1032,15 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     return False
                 if time.monotonic() - last_publish[0] >= publish_every:
                     return True
-                return bool(cfg.save_steps) and ckpt.published_at_risk()
+                # Gated runs check one retention slot EARLY (margin=2):
+                # the very tick this arm triggers may turn out HELD,
+                # and a hold starting at the margin-1 boundary would
+                # leave the mandatory final/preemption save to evict
+                # the last-good step — the reserve the save pause
+                # depends on must exist BEFORE the hold begins.
+                return (bool(cfg.save_steps) and not gate_holding[0]
+                        and ckpt.published_at_risk(
+                            margin=2 if gate is not None else 1))
 
             def stream_gauges():
                 if tel is None:
@@ -861,7 +1049,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                         tracker.watermark_lag_seconds())
                 if publish_every > 0:
                     tel.set("stream/last_publish_age_seconds",
-                            time.monotonic() - last_publish[0])
+                            time.monotonic() - last_publish_ok[0])
 
             def stream_save(wait: bool, force: bool = False) -> None:
                 nonlocal last_periodic_save
@@ -878,10 +1066,16 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     tel.count("train/checkpoints")
 
             def do_publish() -> None:
-                """save + settle the manifest + verify + atomically
-                repoint the ``published`` pointer. Lockstep-safe: every
-                worker runs the save's commit barrier; only process 0
-                flips the pointer."""
+                """Quality eval + gate, then save + settle the
+                manifest + verify + atomically repoint the
+                ``published`` pointer. A HELD decision skips the save
+                too: a held tick must not mint a new step — retention
+                (max_to_keep) could otherwise use held steps to lap
+                the published pointer, deleting the exact "last good
+                triple" the gate exists to keep serving. Lockstep-safe:
+                the decision is chief-broadcast, so every worker runs
+                the save's commit barrier (or skips it) together; only
+                process 0 flips the pointer."""
                 with span("checkpoint/publish", step=global_step):
                     # fmlint: disable=R003 -- feeds the train/
                     # checkpoint_pause_seconds counter (the publish
@@ -893,16 +1087,38 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # eviction coherent — evicted rows reset BEFORE
                     # the save, so the published step serves evicted
                     # ids from the cold row, never stale embeddings.
+                    # (It runs before the quality eval, so the sweep
+                    # measures exactly the state a pass would publish.)
                     _vocab_barrier(f"publish step {global_step}")
-                    # force=True: a publish can land on the SAME step
-                    # as the last periodic save, and the barrier above
-                    # just moved the in-memory (table, slot map) pair —
-                    # the benign same-step-collision skip would pair
-                    # the old arrays with the new sidecar. Forcing
-                    # rewrites both, so the published triple is
-                    # coherent.
-                    stream_save(wait=True, force=vocab is not None)
-                    ckpt.publish_step(global_step)
+                    decision = _publish_decision()
+                    gate_holding[0] = bool(decision
+                                           and decision.get("held"))
+                    if not gate_holding[0]:
+                        risk_pause_logged[0] = False
+                    if decision is None or not decision.get("held"):
+                        # force=True: a publish can land on the SAME
+                        # step as the last periodic save, and the
+                        # barrier above just moved the in-memory
+                        # (table, slot map) pair — the benign same-
+                        # step-collision skip would pair the old
+                        # arrays with the new sidecar. Forcing
+                        # rewrites both, so the published triple is
+                        # coherent.
+                        stream_save(wait=True, force=vocab is not None)
+                        ok = ckpt.publish_step(global_step) is not None
+                        # Non-chief workers assume the chief's verify
+                        # passed (publish_step is process-0-only; a
+                        # verify failure is already counted and the
+                        # decision stream stays chief-broadcast, so a
+                        # rare divergent baseline here cannot diverge
+                        # an outcome).
+                        if ok or jax.process_index() != 0:
+                            last_publish_ok[0] = time.monotonic()
+                            _gate_published(decision)
+                    # held: no save, no publish — and once the
+                    # published step reaches the retention boundary,
+                    # step_once pauses periodic saves too, so GC can
+                    # never evict the last-good checkpoint mid-hold.
                     if tel is not None:
                         # fmlint: disable=R003 -- closes the sample
                         tel.count("train/checkpoint_pause_seconds",
@@ -983,16 +1199,54 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     stream_gauges()
                     tel.maybe_flush(global_step)
                 if cfg.save_steps and global_step % cfg.save_steps == 0:
-                    # fmlint: disable=R003 -- feeds the train/
-                    # checkpoint_pause_seconds counter
-                    t_ck = time.perf_counter()
-                    stream_save(wait=offload)
-                    if tel is not None:
-                        # fmlint: disable=R003 -- closes the sample
-                        dt_ck = time.perf_counter() - t_ck
-                        tel.count("train/checkpoint_pause_seconds",
-                                  dt_ck)
-                        t_prev[0] += dt_ck
+                    # margin=2: stop one slot shy of the boundary so
+                    # the mandatory final/preemption save can still
+                    # land without evicting the last-good step.
+                    if (gate_holding[0]
+                            and ckpt.published_at_risk(margin=2)):
+                        # Retention pause: while the gate is HOLDING,
+                        # a periodic save that would push the
+                        # published (last-good) step past max_to_keep
+                        # must not run — orbax's newest-N eviction has
+                        # no pin, so minting the step would delete the
+                        # exact checkpoint the fleet is serving from
+                        # (published_at_risk's "the pointer never
+                        # names a deleted step" contract). Durability
+                        # pauses for the hold — progress since the
+                        # last save is re-trained on a crash, exactly
+                        # once via the watermark — and resumes when
+                        # the gate passes (the publish repoints at
+                        # fresh state, clearing the risk).
+                        if not risk_pause_logged[0]:
+                            risk_pause_logged[0] = True
+                            logger.warning(
+                                "publish gate holding with the "
+                                "published step at the retention "
+                                "boundary: pausing periodic saves so "
+                                "GC cannot evict the last-good "
+                                "checkpoint; heal the input stream "
+                                "(or raise max_to_keep) to resume")
+                    else:
+                        # fmlint: disable=R003 -- feeds the train/
+                        # checkpoint_pause_seconds counter
+                        t_ck = time.perf_counter()
+                        # Gated runs save SYNCHRONOUSLY: the retention
+                        # math protecting the published step (the
+                        # margin=2 risk arm + the hold pause above)
+                        # reasons over COMMITTED step dirs — an async
+                        # save's invisible in-flight step would let a
+                        # hold latch with the window already full, and
+                        # the mandatory final save would then evict
+                        # the exact last-good checkpoint the gate
+                        # pinned (caught by the retention-pause e2e
+                        # test).
+                        stream_save(wait=offload or gate is not None)
+                        if tel is not None:
+                            # fmlint: disable=R003 -- closes the sample
+                            dt_ck = time.perf_counter() - t_ck
+                            tel.count("train/checkpoint_pause_seconds",
+                                      dt_ck)
+                            t_prev[0] += dt_ck
 
             def emit_preempted() -> None:
                 nonlocal stopping
@@ -1449,14 +1703,48 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             # The exit publish: a clean STOP drain (or a preemption's
             # durable save) is the freshest verified state a scorer
             # can hot-reload; the save above already settled the
-            # manifest (wait=True).
-            ckpt.publish_step(global_step)
-        if stream_mode and not multi_process and cfg.validation_files:
+            # manifest (wait=True). Gated like every other publish —
+            # a run whose tail regressed quality must exit with the
+            # pointer still on the last passing step (the final save
+            # itself always lands: resume durability is not gated).
+            # A PREEMPTED exit skips the quality sweep: the grace
+            # window between SIGTERM and the orchestrator's SIGKILL
+            # has no budget for a validation pass, and a mid-sweep
+            # kill would lose the publish entirely — so a gate-less
+            # run publishes immediately (the historical behavior) and
+            # a gated run leaves the pointer on the last step the gate
+            # actually passed rather than publishing unevaluated state.
+            if stopping and gate is not None:
+                logger.info(
+                    "preempted with a publish gate configured: exit "
+                    "publish skipped (no quality sweep inside the "
+                    "grace window); the pointer stays on the last "
+                    "passing step")
+            else:
+                decision = (None if stopping
+                            else _publish_decision())
+                if decision is not None:
+                    # The exit sweep IS this table's final validation:
+                    # _chief_finalize (multi-process) must not re-run
+                    # it.
+                    last_val = (decision["auc"], decision["examples"])
+                if decision is None or not decision.get("held"):
+                    if ckpt.publish_step(global_step) is not None:
+                        # Persist the exit publish's baseline too —
+                        # it is exactly what the NEXT run's gate must
+                        # re-arm from.
+                        _gate_published(decision)
+                        if tel is not None:
+                            tel.set("stream/last_publish_age_seconds",
+                                    0.0)
+        if (stream_mode and not multi_process and cfg.validation_files
+                and not quality_on):
             # Stream mode has no per-epoch sweeps; a configured
             # validation corpus gets one final scored pass here
-            # (multi-process streams validate in _chief_finalize below)
-            # — silently accepting-and-ignoring the knob would be a
-            # config trap.
+            # (multi-process streams validate in _chief_finalize below;
+            # publishing streams already validated through the exit
+            # publish's quality sweep just above) — silently
+            # accepting-and-ignoring the knob would be a config trap.
             auc, n = evaluate(
                 cfg, table, cfg.validation_files, mesh=mesh,
                 backend=lk, max_batches=cfg.validation_max_batches

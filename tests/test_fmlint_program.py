@@ -1,5 +1,5 @@
 """tools/fmlint whole-program layer: the project loader (imports, call
-graph, summaries), the cross-file rules R007-R010, the committed
+graph, summaries), the cross-file rules R007-R012, the committed
 baseline, --json — and the seeded-mutant acceptance test proving R007
 catches a rank-gated collective planted in the REAL checkpoint.py
 restore path."""
@@ -616,6 +616,111 @@ def test_cli_update_baseline_round_trip(tmp_path, capsys):
     # repo root; this round-trip exercises an explicit --baseline file
     # against the same surface it was recorded from.
     assert main(["--baseline", str(bl), root]) == 0
+
+
+# --- R012: health-catalog drift --------------------------------------------
+
+_ATT_OK = """\
+    HEALTH_KINDS = frozenset({"stalled", "gate_held"})
+"""
+
+_EMITTERS = """\
+    def watchdog(sink):
+        sink.emit("health", {"status": "stalled", "step": 3})
+
+    def gate(tel):
+        fields = {"status": "gate_held", "auc": 0.2}
+        tel.sink.emit("health", fields)
+"""
+
+
+def _r012_files(att=_ATT_OK, emitters=_EMITTERS,
+                readme="catalog: stalled and gate_held rows\n"):
+    return {"fast_tffm_tpu/obs/attribution.py": att,
+            "fast_tffm_tpu/obs/emitters.py": emitters,
+            "README.md": readme}
+
+
+def test_r012_clean_when_catalog_covers_emits(tmp_path):
+    assert _findings(tmp_path, _r012_files(), rule="R012") == []
+
+
+def test_r012_flags_unmapped_emitted_kind(tmp_path):
+    found = _findings(tmp_path, _r012_files(
+        emitters=_EMITTERS + """\
+
+    def rogue(sink):
+        sink.emit("health", {"status": "zombie", "step": 1})
+""",
+        readme="stalled gate_held zombie\n"), rule="R012")
+    assert len(found) == 1
+    assert "zombie" in found[0].message
+    assert "HEALTH_KINDS" in found[0].message
+    assert found[0].path.endswith("emitters.py")
+
+
+def test_r012_flags_missing_readme_row(tmp_path):
+    found = _findings(tmp_path, _r012_files(
+        readme="only stalled is documented\n"), rule="R012")
+    assert len(found) == 1
+    assert "gate_held" in found[0].message
+    assert "README" in found[0].message
+
+
+def test_r012_flags_stale_catalog_entry(tmp_path):
+    found = _findings(tmp_path, _r012_files(
+        att='HEALTH_KINDS = frozenset({"stalled", "gate_held", '
+            '"ghost"})\n',
+        readme="stalled gate_held ghost\n"), rule="R012")
+    assert len(found) == 1
+    assert "ghost" in found[0].message
+    assert "stale" in found[0].message
+    assert found[0].path.endswith("attribution.py")
+
+
+def test_r012_ignores_status_dicts_without_health_emit(tmp_path):
+    """A {"status": ...} dict that is not a health-emit PAYLOAD is not
+    a health kind — whether it lives in a non-emitting scope (an HTTP
+    stats payload) or right beside an emit in the same function (the
+    scan anchors on the emit call's argument, not the whole scope)."""
+    found = _findings(tmp_path, _r012_files(
+        emitters=_EMITTERS + """\
+
+    def stats():
+        return {"status": "ok", "uptime": 1.0}
+
+    def emit_and_report(sink):
+        sink.emit("health", {"status": "stalled"})
+        return {"status": "weird_unrelated"}
+"""), rule="R012")
+    assert found == []
+
+
+def test_r012_one_readme_finding_per_kind(tmp_path):
+    """A kind emitted from several sites with its README row missing
+    is ONE finding (the missing artifact is the catalog row), while
+    the HEALTH_KINDS mapping check stays per-site."""
+    found = _findings(tmp_path, _r012_files(
+        emitters=_EMITTERS + """\
+
+    def again(sink):
+        sink.emit("health", {"status": "gate_held", "step": 9})
+""",
+        readme="only stalled is documented\n"), rule="R012")
+    assert len(found) == 1
+    assert "gate_held" in found[0].message
+    assert "README" in found[0].message
+
+
+def test_r012_pragma_escape(tmp_path):
+    found = _findings(tmp_path, _r012_files(
+        emitters=_EMITTERS + """\
+
+    def experimental(sink):
+        sink.emit("health", {"status": "wip_kind"})  # fmlint: disable=R012 -- staged rollout, catalog lands next PR
+""",
+        readme="stalled gate_held wip_kind\n"), rule="R012")
+    assert found == []
 
 
 def test_repo_baseline_is_empty():

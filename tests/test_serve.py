@@ -348,6 +348,56 @@ def test_http_round_trip(trained):
         server.close()
 
 
+def test_metrics_endpoint_prometheus(trained):
+    """GET /metrics serves the obs registry in Prometheus text
+    exposition format: counters/gauges bare, histograms as cumulative
+    le-buckets + _sum/_count, correct content type — scrapeable
+    without parsing JSONL."""
+    from fast_tffm_tpu.serve.frontend import make_http_server
+    cfg, steps, _wd = trained
+    server = _server(cfg)
+    httpd = make_http_server(server, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = httpd.server_address[:2]
+        server.score_lines(_corpus_lines(3, seed=53), timeout=30)
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            text = resp.read().decode()
+        lines = text.splitlines()
+        assert "# TYPE fm_serve_requests counter" in lines
+        assert "fm_serve_requests 1" in lines
+        assert "# TYPE fm_serve_served_step gauge" in lines
+        assert f"fm_serve_served_step {steps[0]}" in lines
+        # Histogram convention: cumulative buckets, +Inf, sum, count.
+        assert ("# TYPE fm_serve_request_latency_ms histogram"
+                in lines)
+        buckets = [ln for ln in lines if ln.startswith(
+            'fm_serve_request_latency_ms_bucket{le="')]
+        assert buckets and buckets[-1].startswith(
+            'fm_serve_request_latency_ms_bucket{le="+Inf"}')
+        counts = [int(b.rsplit(" ", 1)[1]) for b in buckets]
+        assert counts == sorted(counts)  # cumulative
+        assert counts[-1] == 1
+        assert any(ln.startswith("fm_serve_request_latency_ms_sum ")
+                   for ln in lines)
+        assert "fm_serve_request_latency_ms_count 1" in lines
+        # The endpoint reflects the live registry: another request
+        # bumps the counter on the next scrape.
+        server.score_lines(_corpus_lines(2, seed=54), timeout=30)
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=30) as resp:
+            assert "fm_serve_requests 2" in resp.read().decode()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        server.close()
+
+
 def test_close_is_clean_and_idempotent(trained):
     cfg, _steps, _wd = trained
     server = _server(cfg)

@@ -52,6 +52,20 @@ asserts the documented recovery behavior:
                       to a clean single-pass control run over the same
                       sealed corpus) and >= 2 ``published`` pointer
                       flips land on manifest-verified steps.
+- ``slo-soak``        the FULL closed loop under SLOs (README "SLOs &
+                      quality gate"): a live writer feeds the stream,
+                      a gated trainer (``publish_min_auc``) publishes
+                      on interval, and a ScorerServer serves a
+                      concurrent request load against the moving
+                      pointer; a label-flipped poison burst must be
+                      caught by the publish gate (pointer pinned to
+                      the last good step, ``health: gate_held``,
+                      fmstat GATE-HELD, serving uninterrupted), clean
+                      data heals the loop, and at the end every
+                      declared SLO passes: publish staleness, serve
+                      p99, exactly-once consumption, min AUC, and
+                      per-step bit-parity of every response against
+                      an offline predict control snapshot.
 - ``stream-truncate`` an in-progress (unsealed) stream file SHRINKS
                       under the reader → the (inode, size) regression
                       is quarantined through the BadLineTracker, the
@@ -1113,6 +1127,389 @@ def scenario_vocab_churn(workdir: str, seed: int = 0) -> str:
             "from the cold row")
 
 
+def scenario_slo_soak(workdir: str, seed: int = 0) -> str:
+    """ISSUE 13 acceptance: the FULL closed loop under SLOs. A live
+    writer feeds the stream, a gated trainer (``publish_min_auc``)
+    publishes on interval, and a ScorerServer serves a concurrent
+    request load against the moving pointer. Mid-soak a POISONED burst
+    (label-flipped shard) arrives: the per-publish quality sweep must
+    catch the regression — the ``published`` pointer never advances to
+    a held step, ``health: gate_held`` fires, fmstat's verdict reads
+    GATE-HELD — while serving continues uninterrupted on the last
+    good step. Clean data then heals the model, publishes resume, and
+    at the end EVERY SLO must hold: publish staleness bound, serve
+    p99 bound, exactly-once stream consumption, minimum quality AUC,
+    and per-step response parity — every served score bit-identical
+    to offline predict against a control snapshot of the step that
+    scored it (snapshots taken at pointer-observation time, so
+    retention GC can't erase the evidence)."""
+    import dataclasses as dc
+    import shutil
+    import subprocess
+    import sys
+    import threading
+    import time as _time
+    from fast_tffm_tpu.checkpoint import read_published
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.metrics import sigmoid
+    from fast_tffm_tpu.obs.attribution import render
+    from fast_tffm_tpu.obs.slo import SloSpec, evaluate_slos, overall
+    from fast_tffm_tpu.predict import load_table, predict_scores
+    from fast_tffm_tpu.serve import ScoreClient, ScorerServer
+    from tools.fmstat import main as fmstat_main
+
+    workdir = os.path.abspath(workdir)
+    sd = os.path.join(workdir, "stream")
+    os.makedirs(sd, exist_ok=True)
+    val = os.path.join(workdir, "val.txt")
+    _write_corpus(val, 240, seed + 1)
+
+    shard_i = [0]
+    total = [0]
+
+    def write_shard(lines) -> None:
+        path = os.path.join(sd, f"part-{shard_i[0]:03d}.txt")
+        shard_i[0] += 1
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        open(path + ".done", "w").close()
+        total[0] += len(lines)
+
+    def flip(line: str) -> str:
+        y, rest = line.split(" ", 1)
+        return f"{1 - int(y)} {rest}"
+
+    write_shard(_corpus_lines(400, seed))
+    write_shard(_corpus_lines(400, seed + 2))
+
+    # The trainer runs as a REAL process driving run_tffm.py (the
+    # production entry point): the harness orchestrates purely through
+    # the filesystem — the stream dir, the published pointer, and the
+    # metrics JSONL — exactly like an operator's deployment.
+    MIN_AUC = 0.7
+    cfg_path = os.path.join(workdir, "slo_soak.cfg")
+    with open(cfg_path, "w") as fh:
+        fh.write(f"""
+[General]
+vocabulary_size = 200
+factor_num = 4
+model_file = {os.path.join(workdir, 'model', 'fm')}
+log_file = {os.path.join(workdir, 'trainer.log')}
+
+[Train]
+run_mode = stream
+stream_dir = {sd}
+stream_poll_seconds = 0.05
+seal_policy = done
+shuffle = false
+epoch_num = 1
+batch_size = 32
+learning_rate = 0.1
+log_steps = 0
+metrics_file = {os.path.join(workdir, 'metrics.jsonl')}
+metrics_flush_steps = 2
+io_backoff_seconds = 0.01
+publish_interval_seconds = 0.2
+publish_min_auc = {MIN_AUC}
+validation_files = {val}
+
+[SLO]
+slo_publish_staleness_seconds = 60
+slo_p99_ms = 10000
+slo_min_auc = {MIN_AUC}
+slo_max_bad_fraction = 0.001
+""")
+    cfg = load_config(cfg_path)
+    ckpt_dir = cfg.model_file + ".ckpt"
+    serve_metrics = os.path.join(workdir, "serve_metrics.jsonl")
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    trainer_out_path = os.path.join(workdir, "trainer.out")
+    trainer_out = open(trainer_out_path, "w")
+    trainer = subprocess.Popen(
+        [sys.executable, "run_tffm.py", "train", cfg_path],
+        cwd=repo, env=env, stdout=trainer_out,
+        stderr=subprocess.STDOUT)
+
+    def _trainer_tail() -> str:
+        try:
+            with open(trainer_out_path) as fh:
+                return fh.read()[-3000:]
+        except OSError:
+            return "<no trainer output>"
+
+    def wait_for(fn, what, deadline_s: float = 180.0):
+        deadline = _time.monotonic() + deadline_s
+        while True:
+            v = fn()
+            if v not in (None, False) and v != []:
+                return v
+            assert trainer.poll() is None, (
+                f"trainer exited (rc {trainer.returncode}) before "
+                f"{what}:\n{_trainer_tail()}")
+            assert _time.monotonic() < deadline, (
+                f"timed out waiting for {what}")
+            _time.sleep(0.02)
+
+    # Best-effort teardown on ANY exit: a wait_for timeout or a
+    # failed assertion must not leak a live training subprocess
+    # (polling the stream forever) or server/client threads into
+    # the rest of the suite.
+    server = None
+    clients = []
+    poller = None
+    stop_firing = threading.Event()
+    stop_polling = threading.Event()
+    try:
+        # The first publish only lands once the gate passes — an untrained
+        # model's validation AUC holds publish_min_auc, so a pointer here
+        # already proves the gate's first-publish (min-AUC-only) path ran.
+        wait_for(lambda: read_published(ckpt_dir) is not None,
+                 "first gate-passing publish")
+
+        # Pointer trajectory + per-step offline CONTROL snapshots: each
+        # newly observed published step dir (and its manifest) is copied
+        # out at observation time, so the end-of-run parity check can
+        # score against steps max_to_keep retention GC'd long before the
+        # soak ended.
+        ctl_prefix = os.path.join(workdir, "control", "fm")
+        ctl_dir = ctl_prefix + ".ckpt"
+        os.makedirs(ctl_dir, exist_ok=True)
+        # pub_seen records every pointer value OBSERVED (the held-step
+        # and response-subset assertions key on observation, not on
+        # snapshot success); ctl_ok records the steps whose control
+        # snapshot actually landed — a copytree can lose a race with
+        # retention GC, in which case that step's parity is checked
+        # only if the server also never scored it.
+        pub_seen = set()
+        ctl_ok = set()
+
+        def snapshot(step) -> bool:
+            src = os.path.join(ckpt_dir, str(step))
+            dst = os.path.join(ctl_dir, str(step))
+            if os.path.isdir(dst):
+                return True
+            if not os.path.isdir(src):
+                return False
+            try:
+                shutil.copytree(src, dst)
+                man = os.path.join(ckpt_dir, f"manifest-{step}.json")
+                if os.path.isfile(man):
+                    shutil.copy(man, os.path.join(
+                        ctl_dir, f"manifest-{step}.json"))
+                return True
+            except OSError:
+                # racing retention GC mid-copy: drop the partial snapshot
+                # and let the next poll retry (the pointer only names live
+                # steps, so a re-observation re-snapshots it)
+                shutil.rmtree(dst, ignore_errors=True)
+                return False
+
+        def poll_pointer():
+            while not stop_polling.is_set():
+                s = read_published(ckpt_dir)
+                if s is not None:
+                    pub_seen.add(s)
+                    # Retry failed/pending snapshots while their step
+                    # dirs are still live (GC may yet win — that only
+                    # weakens parity for a step nothing served).
+                    for p in pub_seen - ctl_ok:
+                        if snapshot(p):
+                            ctl_ok.add(p)
+                _time.sleep(0.005)
+
+        poller = threading.Thread(target=poll_pointer,
+                                  name="slo-pointer-poll", daemon=True)
+        poller.start()
+        wait_for(lambda: bool(ctl_ok), "pointer snapshot")
+
+        # The serving plane, live against the moving pointer.
+        scfg = dc.replace(cfg, metrics_file=serve_metrics,
+                          serve_poll_seconds=0.02, serve_max_batch=8,
+                          serve_max_wait_ms=2.0)
+        server = ScorerServer(scfg)
+        client = ScoreClient(server)
+        req_lines = _corpus_lines(60, seed + 99)
+        results, res_lock, errors = [], threading.Lock(), []
+
+        def fire(worker: int) -> None:
+            rng = np.random.default_rng(seed + worker)
+            while not stop_firing.is_set():
+                k = int(rng.integers(1, 6))
+                lo = int(rng.integers(0, len(req_lines) - k))
+                lines = req_lines[lo:lo + k]
+                try:
+                    res = client.score(lines, timeout=30)
+                except Exception as e:  # noqa: BLE001 - assert at the end
+                    errors.append(e)
+                    return
+                with res_lock:
+                    results.append((lines, res.scores, res.step))
+
+        clients = [threading.Thread(target=fire, args=(i,),
+                                    name=f"slo-client-{i}")
+                   for i in range(3)]
+        for t in clients:
+            t.start()
+        wait_for(lambda: len(results) >= 5, "first served responses")
+
+        # The poisoned burst: the same feature distribution with every
+        # label flipped — training through it inverts the model, and the
+        # next publish tick's validation sweep must catch it.
+        write_shard([flip(ln) for ln in _corpus_lines(1600, seed + 3)])
+
+        def gate_events():
+            return [h for h in _summary(cfg).get("health_events", [])
+                    if h.get("status") == "gate_held"]
+
+        held = wait_for(gate_events, "gate_held health event")
+        held_steps = {int(h["step"]) for h in held}
+        pub_at_hold = read_published(ckpt_dir)
+        n_before_recovery = len(results)
+
+        # Recovery: clean shards until a NEW step publishes past the hold
+        # — the closed loop healing itself.
+        write_shard(_corpus_lines(800, seed + 4))
+        write_shard(_corpus_lines(800, seed + 5))
+        wait_for(lambda: read_published(ckpt_dir) not in (None,
+                                                          pub_at_hold),
+                 "post-recovery publish")
+        open(os.path.join(sd, "STOP"), "w").close()
+        try:
+            rc = trainer.wait(timeout=180)
+        except subprocess.TimeoutExpired:
+            trainer.kill()
+            raise AssertionError(
+                f"trainer never drained the stream:\n{_trainer_tail()}")
+        finally:
+            trainer_out.close()
+        assert rc == 0, f"trainer failed (rc {rc}):\n{_trainer_tail()}"
+        final_pub = read_published(ckpt_dir)
+        assert final_pub is not None
+        pub_seen.add(final_pub)
+        if snapshot(final_pub):  # post-join: the final step is live
+            ctl_ok.add(final_pub)
+        # Let the server observe the exit publish so responses cover the
+        # final step too, then stop traffic.
+        deadline = _time.monotonic() + 30
+        while (server.served_step != final_pub
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
+        assert server.served_step == final_pub, (
+            f"server never reloaded the final published step {final_pub} "
+            f"(serving {server.served_step})")
+        _time.sleep(0.1)  # a few requests on the final step
+        stop_firing.set()
+        for t in clients:
+            t.join()
+        assert not errors, errors[:3]
+        server.close()
+        stop_polling.set()
+        poller.join(timeout=5)
+
+        # --- the five SLO assertions -------------------------------------
+        c = _counters(cfg)
+        # (1) exactly-once consumption: every written line (good AND
+        # poisoned) trained exactly once.
+        assert c.get("train/examples") == total[0], (
+            c.get("train/examples"), total[0])
+        # (2) the gate caught the burst: >= 1 hold, the held steps never
+        # published and never served, and serving CONTINUED through the
+        # hold (responses kept landing before the recovery publish).
+        assert held_steps, "no gate_held step recorded"
+        assert int(c.get("quality/gate_held", 0)) >= 1, c
+        assert not held_steps & pub_seen, (
+            f"held step(s) {held_steps & pub_seen} reached the pointer")
+        resp_steps = {r[2] for r in results}
+        assert not held_steps & resp_steps, (
+            f"held step(s) {held_steps & resp_steps} served traffic")
+        assert resp_steps <= pub_seen, (
+            f"responses tagged unpublished steps: {resp_steps - pub_seen}")
+        assert len(results) > n_before_recovery, (
+            "serving stalled during the gate hold")
+        assert len(pub_seen) >= 2, pub_seen
+        # (3) per-step score parity with the offline predict control: every
+        # response bit-identical against its step's snapshot.
+        pcfg = dc.replace(cfg, metrics_file="", model_file=ctl_prefix,
+                          run_mode="epochs", stream_dir="",
+                          publish_interval_seconds=0.0,
+                          publish_min_auc=0.0, validation_files=())
+        by_step = {}
+        for lines, scores, step in results:
+            by_step.setdefault(step, []).append((lines, scores))
+        # Every SERVED step must have its control snapshot: the server
+        # loads a step strictly after publishing it, and the retry
+        # loop re-snapshots while the dir is live, so only a step
+        # nothing ever served may legitimately lose the GC race.
+        assert set(by_step) <= ctl_ok, (
+            f"served step(s) {set(by_step) - ctl_ok} have no control "
+            f"snapshot (observed {sorted(pub_seen)}, "
+            f"snapshotted {sorted(ctl_ok)})")
+        assert final_pub in by_step, (
+            f"no responses landed on the final step {final_pub}")
+        for step, pairs in sorted(by_step.items()):
+            table = load_table(pcfg, step=step)
+            req_path = os.path.join(workdir, f"requests_{step}.txt")
+            flat, sizes = [], []
+            for lines, _scores in pairs:
+                flat.extend(lines)
+                sizes.append(len(lines))
+            with open(req_path, "w") as fh:
+                fh.write("\n".join(flat) + "\n")
+            want = sigmoid(predict_scores(pcfg, table, [req_path]))
+            pos = 0
+            for (lines, scores), n in zip(pairs, sizes):
+                ref = want[pos:pos + n]
+                pos += n
+                assert np.array_equal(ref, scores), (
+                    f"step {step}: served scores diverged from the "
+                    f"offline predict control ({scores[:3]} vs {ref[:3]})")
+        # (4) + (5) the declared SLOs all PASS from the JSONL alone —
+        # publish staleness, serve p99, min AUC (recovered past the
+        # poison), bad fraction — via the library AND the fmstat slo CLI.
+        from fast_tffm_tpu.obs.attribution import summarize
+        summary = summarize([cfg.metrics_file, serve_metrics])
+        spec = SloSpec.from_summary(summary)
+        slo_rows = evaluate_slos(spec, summary)
+        assert len(slo_rows) == 4, slo_rows
+        assert overall(slo_rows) == "PASS", [
+            (r.objective, r.status, r.measured) for r in slo_rows]
+        assert fmstat_main(["slo", cfg.metrics_file, serve_metrics,
+                            "--json"]) == 0
+        # fmstat renders the verdict + QUALITY section.
+        v = _verdict(cfg)
+        assert v.startswith("GATE-HELD"), v
+        text = render(_summary(cfg))
+        assert "QUALITY (per-publish eval + gate)" in text, text
+        auc_final = summary["gauges"].get("quality/auc")
+        return (f"{total[0]} streamed lines trained exactly once; gate "
+                f"held {len(held)}x at step(s) {sorted(held_steps)} on the "
+                f"poisoned burst (pointer pinned, serving continued), "
+                f"{len(pub_seen)} publishes landed, {len(results)} "
+                f"concurrent responses across {len(by_step)} step(s) all "
+                f"bit-identical to the offline control, final AUC "
+                f"{auc_final:.3f}, all 4 SLOs PASS")
+    finally:
+        stop_firing.set()
+        stop_polling.set()
+        for t in clients:
+            t.join(timeout=10)
+        if poller is not None:
+            poller.join(timeout=5)
+        if server is not None:
+            server.close()  # idempotent: a no-op on the orderly path
+        if trainer.poll() is None:
+            trainer.kill()
+            trainer.wait(timeout=30)
+        try:
+            trainer_out.close()
+        except OSError:
+            pass
+
+
 # --- multi-worker compute-plane scenarios --------------------------------
 
 
@@ -1397,6 +1794,7 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "serve-soak": scenario_serve_soak,
     "preempt-resume": scenario_preempt_resume,
     "stream-soak": scenario_stream_soak,
+    "slo-soak": scenario_slo_soak,
     "stream-truncate": scenario_stream_truncate,
     "vocab-churn": scenario_vocab_churn,
     "truncate-latest": scenario_truncate_latest,

@@ -1,4 +1,4 @@
-"""fmlint whole-program rules (R007-R010) over tools/fmlint/project.py.
+"""fmlint whole-program rules (R007-R012) over tools/fmlint/project.py.
 
 These are the bug classes PRs 3-5's reviews kept catching by hand —
 whole-program properties no per-file syntactic rule can see:
@@ -23,6 +23,13 @@ R010  unwrapped hot-path IO: a raw ``open()`` in the pipeline/
       (``open_with_retry`` / ``retry_io`` / ``@retrying``) nor sits
       under an explicit OSError-family handler — IO with no failure
       contract on exactly the paths transient NFS errors hit.
+R012  health-catalog drift: every ``health: <kind>`` event emitted
+      anywhere must appear in obs/attribution.HEALTH_KINDS (the fmstat
+      verdict/notes mapping) AND in the README's health-event catalog;
+      a catalog entry nothing emits is stale — the drift gate that
+      keeps "fmstat explains every event the system can write" true
+      as subsystems grow (the R009 pattern applied to the health
+      stream).
 
 Each rule returns standard Findings, so the pragma grammar and the
 baseline mechanism apply unchanged. Precision policy: the engine's
@@ -264,8 +271,9 @@ def r008_unsynchronized_shared_mutation(proj: Project) -> List[Finding]:
 # --- R009: config/knob drift ----------------------------------------------
 
 _SECTION_BY_DICT = {"_GENERAL_KEYS": "General", "_TRAIN_KEYS": "Train",
-                    "_VOCAB_KEYS": "Vocab", "_PREDICT_KEYS": "Predict",
-                    "_SERVE_KEYS": "Serve", "_CLUSTER_KEYS": "Cluster"}
+                    "_SLO_KEYS": "SLO", "_VOCAB_KEYS": "Vocab",
+                    "_PREDICT_KEYS": "Predict", "_SERVE_KEYS": "Serve",
+                    "_CLUSTER_KEYS": "Cluster"}
 
 
 def _config_schema(mod) -> Tuple[Dict[str, Dict[str, int]], Set[str]]:
@@ -499,7 +507,149 @@ def r010_unwrapped_io(proj: Project) -> List[Finding]:
     return found
 
 
+# --- R012: health-event catalog drift --------------------------------------
+
+_ATTRIBUTION_SUFFIX = "fast_tffm_tpu/obs/attribution.py"
+_HEALTH_SET_NAME = "HEALTH_KINDS"
+
+
+def _function_scopes(tree) -> Iterable[ast.AST]:
+    """Every def (and the module itself) as one scope: the emit call
+    and its status-dict always share a function in this codebase
+    (inline literal, or a ``fields = {...}`` built beside the call)."""
+    yield tree
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n
+
+
+def _scope_own_nodes(scope) -> Iterable[ast.AST]:
+    """Walk one scope's own statements, not nested defs' (a nested
+    def is its own scope in _function_scopes — walking it here too
+    would double-report every site)."""
+    body = scope.body if hasattr(scope, "body") else []
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from _walk_skip_defs(stmt)
+
+
+def _health_emit_payloads(scope) -> Iterable[ast.Dict]:
+    """The dict literals actually PASSED to an ``emit("health", ...)``
+    call in this scope: an inline ``emit("health", {...})`` argument,
+    or the scope-local ``fields = {...}`` a name argument resolves to.
+    Anchoring on the argument (not every dict in the scope) keeps an
+    unrelated ``{"status": "ok"}`` stats payload in the same function
+    from being misread as a health kind."""
+    assigns: Dict[str, List[ast.Dict]] = {}
+    emits: List[ast.Call] = []
+    for n in _scope_own_nodes(scope):
+        if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+                and isinstance(n.value, ast.Dict)):
+            assigns.setdefault(n.targets[0].id, []).append(n.value)
+        if not (isinstance(n, ast.Call) and n.args
+                and isinstance(n.args[0], ast.Constant)
+                and n.args[0].value == "health"):
+            continue
+        base = None
+        if isinstance(n.func, ast.Name):
+            base = n.func.id
+        elif isinstance(n.func, ast.Attribute):
+            base = n.func.attr
+        if base == "emit":
+            emits.append(n)
+    for call in emits:
+        if len(call.args) < 2:
+            continue
+        payload = call.args[1]
+        if isinstance(payload, ast.Dict):
+            yield payload
+        elif isinstance(payload, ast.Name):
+            yield from assigns.get(payload.id, [])
+
+
+def _emitted_health_kinds(proj) -> List[Tuple[str, str, int]]:
+    """(kind, path, line) for every ``"status": "<kind>"`` literal in
+    a dict a health-event emit actually ships."""
+    out: List[Tuple[str, str, int]] = []
+    for mod in proj.by_path.values():
+        for scope in _function_scopes(mod.tree):
+            for d in _health_emit_payloads(scope):
+                for k, v in zip(d.keys, d.values):
+                    if (isinstance(k, ast.Constant)
+                            and k.value == "status"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        out.append((v.value, mod.path, v.lineno))
+    return out
+
+
+def _catalog_kinds(att_mod) -> Dict[str, int]:
+    """HEALTH_KINDS frozenset contents {kind: line} from
+    attribution.py's AST."""
+    for node in att_mod.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == _HEALTH_SET_NAME
+                and isinstance(node.value, ast.Call)
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Set)):
+            return {e.value: e.lineno
+                    for e in node.value.args[0].elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return {}
+
+
+def r012_health_catalog(proj: Project) -> List[Finding]:
+    att_mod = next((m for m in proj.by_path.values()
+                    if m.path.replace("\\", "/").endswith(
+                        _ATTRIBUTION_SUFFIX)), None)
+    if att_mod is None:
+        return []
+    catalog = _catalog_kinds(att_mod)
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(att_mod.path)))
+    readme_path = os.path.join(root, "README.md")
+    readme_text = None
+    if os.path.isfile(readme_path):
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            readme_text = fh.read()
+    emitted = _emitted_health_kinds(proj)
+    found: List[Finding] = []
+    readme_flagged: Set[str] = set()
+    for kind, path, line in emitted:
+        if kind not in catalog:
+            found.append(Finding(
+                "R012", path, line,
+                f"health kind '{kind}' is emitted here but missing "
+                "from obs/attribution.HEALTH_KINDS — fmstat has no "
+                "verdict/notes mapping for it; map it (and add the "
+                "README catalog row) or justify with a pragma"))
+        if (readme_text is not None and kind not in readme_flagged
+                and not _word_in(readme_text, kind)):
+            # One finding per KIND (at its first emit site), not one
+            # per site: the missing artifact is the catalog row.
+            readme_flagged.add(kind)
+            found.append(Finding(
+                "R012", path, line,
+                f"health kind '{kind}' has no README health-event "
+                "catalog row; document what emits it, what fmstat "
+                "shows, and the first diagnostic"))
+    emitted_kinds = {k for k, _, _ in emitted}
+    for kind, line in sorted(catalog.items()):
+        if kind not in emitted_kinds:
+            found.append(Finding(
+                "R012", att_mod.path, line,
+                f"HEALTH_KINDS entry '{kind}' is emitted nowhere in "
+                "the linted surface — a stale catalog entry (event "
+                "removed?); drop it or justify with a pragma"))
+    return found
+
+
 PROGRAM_RULES = (r007_divergent_collective,
                  r008_unsynchronized_shared_mutation,
                  r009_config_drift,
-                 r010_unwrapped_io)
+                 r010_unwrapped_io,
+                 r012_health_catalog)

@@ -1,10 +1,12 @@
-"""fmstat — summarize or tail a run's metrics JSONL stream.
+"""fmstat — summarize, tail, follow, or SLO-check a metrics stream.
 
 The read-side of the obs/ telemetry subsystem:
 
     python -m tools.fmstat <metrics.jsonl> [more shards...]
     python -m tools.fmstat --json <metrics.jsonl>
     python -m tools.fmstat --tail <metrics.jsonl>
+    python -m tools.fmstat --follow '<metrics.jsonl>*'
+    python -m tools.fmstat slo <metrics.jsonl> [shards...] [--json]
 
 Summary mode merges every given file (a multi-process run's chief file
 plus its ``.p<i>`` worker shards — pass a glob) through the registry's
@@ -24,7 +26,15 @@ publishes, last-publish age — and the health verdict reads
 interval (the serving fleet is reloading stale state). ``--json``
 emits the merged summary + attribution as one JSON object for
 scripting. ``--tail`` follows a live file and pretty-prints events as
-they land.
+they land. ``--follow`` re-renders the full summary + verdict on a
+poll interval as the stream grows — the "watch a live soak" mode —
+re-expanding the file globs each poll so per-worker ``.p<i>`` shards
+appearing mid-run join the merge. The ``slo`` subcommand evaluates
+the run's declared service-level objectives (the ``slo/*`` gauges the
+[SLO] config section stamps into the stream, or ``--config <file>``)
+and prints a per-objective PASS/FAIL table (``--json`` for the
+machine form), exiting non-zero on any FAIL — the one scriptable
+"is this deployment healthy" answer (README "SLOs & quality gate").
 """
 
 from __future__ import annotations
@@ -87,7 +97,110 @@ def _format_event(rec: dict) -> str:
         default=str)[:200]
 
 
+def _expand_tolerant(patterns) -> list:
+    """Glob expansion that tolerates not-yet-existing inputs — the
+    --follow seam (a live run's worker shards appear over time; the
+    strict expand_stream_args policy would kill the watch loop on the
+    very race it exists to observe). Literal paths are kept only once
+    they exist."""
+    import glob as globlib
+    import os
+    files = []
+    for p in patterns:
+        hits = sorted(globlib.glob(p))
+        if hits:
+            files.extend(hits)
+        elif os.path.exists(p):
+            files.append(p)
+    return files
+
+
+def _follow(patterns, interval: float = 2.0, out=sys.stdout,
+            iterations=None) -> int:
+    """Poll-based live summary: re-expand the globs, re-merge, and
+    re-render the full table + verdict every ``interval`` seconds
+    until interrupted (``iterations`` bounds the loop for tests)."""
+    n = 0
+    while iterations is None or n < iterations:
+        files = _expand_tolerant(patterns)
+        if files:
+            try:
+                body = render(summarize(files))
+            except OSError as e:
+                body = f"(stream unreadable this poll: {e})"
+        else:
+            body = f"waiting for {' '.join(patterns)} ..."
+        if out.isatty():
+            out.write("\x1b[2J\x1b[H")  # clear + home: a live panel
+        stamp = time.strftime("%H:%M:%S")
+        out.write(f"-- fmstat --follow {stamp} "
+                  f"({len(files)} file(s)) --\n{body}\n")
+        out.flush()
+        n += 1
+        if iterations is None or n < iterations:
+            time.sleep(interval)
+    return 0
+
+
+def main_slo(argv=None) -> int:
+    """The ``fmstat slo`` subcommand: PASS/FAIL table per declared
+    objective; exit 1 on any FAIL."""
+    from fast_tffm_tpu.obs.slo import (SloSpec, evaluate_slos, overall,
+                                       render_slo, results_json)
+    ap = argparse.ArgumentParser(
+        prog="fmstat slo",
+        description="evaluate a run's declared SLOs over its metrics "
+                    "stream (README 'SLOs & quality gate')")
+    ap.add_argument("files", nargs="+",
+                    help="metrics JSONL file(s); globs ok")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the spec + per-objective results as "
+                         "JSON")
+    ap.add_argument("--config", default="",
+                    help="read the SLO spec from this config file "
+                         "instead of the stream's slo/* gauges")
+    ap.add_argument("--allow-skip", action="store_true",
+                    help="exit 0 even when a configured objective had "
+                         "no supporting data (default: exit 2 — a "
+                         "declared objective that was never measured "
+                         "must not read green in a monitor)")
+    args = ap.parse_args(argv)
+    files = expand_stream_args(args.files)
+    summary = summarize(files)
+    if args.config:
+        from fast_tffm_tpu.config import load_config
+        spec = SloSpec.from_config(load_config(args.config))
+    else:
+        spec = SloSpec.from_summary(summary)
+    results = evaluate_slos(spec, summary)
+    if args.json:
+        out = results_json(spec, results)
+        out["health"] = health_verdict(summary)
+        print(json.dumps(out, default=str))
+    else:
+        print(render_slo(spec, results))
+        hv = health_verdict(summary)
+        print(f"health: {hv['verdict']} — {hv['detail']}")
+    if overall(results) == "FAIL":
+        return 1
+    # SKIP (and an EMPTY spec) are visible in the output, but at the
+    # exit-code level (the scriptable surface) neither may read green:
+    # an unmeasured declared objective — or a stream that carries no
+    # slo/* gauges at all because the metrics file was rotated or
+    # truncated — is exactly when a monitor wired to this command must
+    # fire, not stay silent.
+    if args.allow_skip:
+        return 0
+    if not results or any(r.status == "SKIP" for r in results):
+        return 2
+    return 0
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "slo":
+        return main_slo(argv[1:])
     ap = argparse.ArgumentParser(
         prog="fmstat", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -98,7 +211,18 @@ def main(argv=None) -> int:
                     help="emit merged summary + attribution as JSON")
     ap.add_argument("--tail", action="store_true",
                     help="follow the (first) file, print events live")
+    ap.add_argument("--follow", action="store_true",
+                    help="re-render the merged summary + verdict as "
+                         "the stream grows (globs re-expanded each "
+                         "poll, so worker shards join live)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--follow poll interval in seconds")
     args = ap.parse_args(argv)
+    if args.follow:
+        try:
+            return _follow(args.files, interval=args.interval)
+        except KeyboardInterrupt:
+            return 0
     # Shared glob + fail-loudly-on-unreadable policy (tools/__init__).
     files = expand_stream_args(args.files)
     if args.tail:
