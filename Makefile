@@ -26,6 +26,12 @@
 #   make slo-soak   the closed-loop SLO scenario standalone: gated
 #                   stream trainer + live writer + concurrent serving
 #                   + a poisoned burst the publish gate must catch
+#   make grow-soak  the elastic GROW scenarios standalone: SIGKILL a
+#                   worker, shrink, admit a --join replacement back to
+#                   full membership (bit-identical to an uninterrupted
+#                   control), plus the joiner-dies-mid-rendezvous leg
+#   make bench-multihost  multi-host scaling-efficiency row: real 1-
+#                   and 2-process localhost clusters, per-worker rate
 #   make clean
 
 CXX ?= g++
@@ -72,7 +78,13 @@ serve-soak: $(SO)
 slo-soak: $(SO)
 	JAX_PLATFORMS=cpu python -m tools.fmchaos slo-soak
 
+grow-soak: $(SO)
+	JAX_PLATFORMS=cpu python -m tools.fmchaos kill-then-grow grow-joiner-dies
+
+bench-multihost: $(SO)
+	JAX_PLATFORMS=cpu python bench.py --multihost
+
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict bench-vocab lint chaos stream-soak serve serve-soak slo-soak clean
+.PHONY: all test bench bench-host bench-predict bench-vocab bench-multihost lint chaos stream-soak serve serve-soak slo-soak grow-soak clean

@@ -1009,6 +1009,111 @@ def predict_sweep_main():
     }))
 
 
+def multihost_main():
+    """Standalone multi-host scaling-efficiency line (`python bench.py
+    --multihost` / `make bench-multihost`): REAL 1- and 2-process
+    localhost clusters (jax.distributed + gloo, the same transport the
+    lockstep protocol runs in production CPU smoke clusters) train the
+    same line-sharded corpus; the tracked number is per-worker
+    efficiency — (2-worker global rate / 2) / 1-worker rate — measured
+    from the metrics stream's loop time + example counters, so cluster
+    bring-up (tens of seconds of interpreter+join) stays OUT of the
+    scaling claim. This is ROADMAP item 4's membership-change number:
+    elastic shrink/grow land on exactly this lockstep plane, so a
+    regression in the overlap/window protocol moves this row."""
+    import subprocess
+    import sys
+    import tempfile
+    import socket as socketlib
+
+    def free_port() -> int:
+        with socketlib.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    from fast_tffm_tpu.obs.attribution import summarize
+
+    def loop_rate(paths) -> float:
+        """Examples per WORKER-second: summarize() sums both the
+        example counters and the per-shard loop (step_seconds) sums
+        across the workers' metrics files, so global examples over
+        summed loop seconds is already the per-worker rate — for W=1
+        it is simply the single-process rate, so the efficiency below
+        is a direct ratio (no extra division by W: that would halve
+        the metric, reporting perfect scaling as 0.5)."""
+        s = summarize(paths)
+        loop = (s["hists"].get("train/step_seconds") or {}).get("sum")
+        examples = s["counters"].get("train/examples")
+        return (examples / loop) if loop and examples else 0.0
+
+    n_lines, epochs = 9728, 2  # 304 even steps/epoch at B=32
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    with tempfile.TemporaryDirectory() as tmp:
+        data = os.path.join(tmp, "train.txt")
+        lines = synth_lines(n_lines, 1 << 17)
+        with open(data, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        del lines
+        results = {}
+        for w in (1, 2):
+            wdir = os.path.join(tmp, f"w{w}")
+            os.makedirs(wdir)
+            metrics = os.path.join(wdir, "metrics.jsonl")
+            coord = free_port()
+            hosts = ",".join(f"localhost:{coord - 1000 + i}"
+                             for i in range(w))
+            cfg_path = os.path.join(wdir, "bench.cfg")
+            with open(cfg_path, "w") as fh:
+                fh.write(f"""
+[General]
+vocabulary_size = {1 << 17}
+factor_num = 8
+hash_feature_id = True
+model_file = {os.path.join(wdir, 'model', 'fm')}
+
+[Train]
+train_files = {data}
+epoch_num = {epochs}
+batch_size = 32
+learning_rate = 0.05
+shuffle = False
+log_steps = 0
+metrics_file = {metrics}
+max_features_per_example = 64
+
+[Cluster]
+worker_hosts = {hosts}
+""")
+            argv = [sys.executable, "run_tffm.py", "train", cfg_path]
+            procs = []
+            for i in range(w):
+                a = argv + (["dist_train", "worker", str(i)]
+                            if w > 1 else [])
+                procs.append(subprocess.Popen(
+                    a, cwd=repo, env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            rcs = [p.wait(timeout=900) for p in procs]
+            if any(rcs):
+                raise SystemExit(f"multihost bench: {w}-worker run "
+                                 f"failed (rcs {rcs})")
+            shards = [metrics] + [f"{metrics}.p{i}"
+                                  for i in range(1, w)
+                                  if os.path.exists(f"{metrics}.p{i}")]
+            results[w] = loop_rate(shards)
+    r1, r2 = results.get(1, 0.0), results.get(2, 0.0)
+    print(json.dumps({
+        "metric": "multihost_scaling_efficiency",
+        "value": round(r2 / r1, 3) if r1 and r2 else None,
+        "unit": "2-worker per-worker rate / 1-worker rate",
+        "single_process_eps": round(r1, 1),
+        "two_worker_per_worker_eps": round(r2, 1),
+        "examples": n_lines * epochs,
+    }))
+
+
 if __name__ == "__main__":
     import sys
     if len(sys.argv) > 1 and sys.argv[1] == "--line":
@@ -1023,5 +1128,7 @@ if __name__ == "__main__":
         vocab_overhead_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
         serve_latency_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--multihost":
+        multihost_main()
     else:
         main()

@@ -499,6 +499,39 @@ def _tel():
     return active()
 
 
+def align_orbax_barrier_counters() -> None:
+    """Re-zero orbax's cross-process barrier counters — the broadcast-
+    to-newcomer seam elastic GROW needs.
+
+    Orbax makes its ``sync_global_devices`` barrier keys unique with
+    MODULE-GLOBAL ``itertools.count()`` counters
+    (``orbax.checkpoint.multihost.counters``): every AsyncCheckpointer
+    ever created in the process advances them, and the count is baked
+    into every subsequent barrier key (``<n>_Checkpointer:restore.<step>``).
+    Two processes whose checkpointer HISTORIES differ — an elastic-grow
+    joiner (count 0) rendezvousing with an incumbent that already
+    restored/saved through several sessions — would derive DIFFERENT
+    keys for the same restore and fail orbax's barrier-name assertion
+    (observed: ``sync_global_devices name mismatch
+    ('0_Checkpointer:restore.N')``). Every member constructs its
+    CheckpointState at the same synchronized point running identical
+    code, so re-zeroing here keeps every later allocation aligned
+    across ANY membership history. Best-effort by design: on orbax
+    layout drift the historical behavior (aligned-by-luck fresh
+    processes) remains."""
+    import itertools
+    try:
+        from orbax.checkpoint.multihost import counters
+    except ImportError:
+        return
+    for name in vars(counters):
+        if name.startswith("_") and name.endswith("_counter"):
+            try:
+                setattr(counters, name, itertools.count())
+            except Exception:  # noqa: BLE001 - one misaligned counter
+                pass           # is no worse than not aligning at all
+
+
 class CheckpointState:
     """Manages checkpoints under ``<model_file>.ckpt/`` (orbax needs a
     directory; the reference's ``model_file`` is a path prefix).
@@ -539,6 +572,14 @@ class CheckpointState:
         # scale that must overlap the train loop, not block it.
         self._manifest_thread: Optional[threading.Thread] = None
         os.makedirs(self.directory, exist_ok=True)
+        multi_process = jax.process_count() > 1
+        if multi_process:
+            # Align orbax's history-dependent barrier counters across
+            # the membership: a grown cluster mixes incumbents (many
+            # checkpointers created) with fresh joiners (none), and
+            # mismatched counters mean mismatched barrier keys — see
+            # align_orbax_barrier_counters.
+            align_orbax_barrier_counters()
         self._mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep,
@@ -1156,6 +1197,25 @@ class CheckpointState:
                 return retry_io(self._mngr.restore, s,
                                 policy=self._retry,
                                 op="checkpoint_restore"), None
+            multi_process = jax.process_count() > 1
+            if multi_process:
+                # Multi-process restores stage through HOST RAM: orbax's
+                # direct-to-device deserialization in the multi-process
+                # restore-then-step shape hits a known jaxlib defect
+                # (intermittent SIGSEGV, or SILENT buffer garbage —
+                # negative Adagrad accumulators, 1e37 magnitudes —
+                # observed reproducibly on the elastic-grow reformed
+                # cluster's first restore). Deserializing to numpy and
+                # placing shards via make_array_from_callback uses only
+                # the transfer path every train step already exercises.
+                # Cost: each process transiently materializes the full
+                # arrays on host — the same peak the offload backend's
+                # load already accepts.
+                return _restore_tolerating_legacy_epoch(
+                    template,
+                    lambda t: retry_io(
+                        self._restore_host_staged, s, t,
+                        policy=self._retry, op="checkpoint_restore"))
             return _restore_tolerating_legacy_epoch(
                 template,
                 lambda t: retry_io(
@@ -1164,6 +1224,46 @@ class CheckpointState:
                     policy=self._retry, op="checkpoint_restore"))
         except (ValueError, KeyError, OSError) as e:
             return None, e
+
+    def _restore_host_staged(self, s: int, template):
+        """Restore step ``s`` with array leaves deserialized to host
+        numpy — ``RestoreArgs(restore_type=np.ndarray)`` through a
+        read-only PyTree reader (StandardSave's on-disk format IS the
+        PyTree format; restore_partial uses the same reader shape) —
+        then placed onto each leaf's target sharding with
+        make_array_from_callback. A plain sharding-free template is
+        not enough here: multi-process orbax repopulates the SAVED
+        sharding from the step's metadata and hands back a
+        non-addressable global array. See _attempt_restore for why
+        this path must not let orbax deserialize straight into device
+        buffers."""
+        host_template = {
+            k: (jax.ShapeDtypeStruct(v.shape, v.dtype)
+                if isinstance(v, jax.ShapeDtypeStruct) else v)
+            for k, v in template.items()}
+        restore_args = {
+            k: (ocp.RestoreArgs(restore_type=np.ndarray)
+                if isinstance(v, jax.ShapeDtypeStruct)
+                else ocp.RestoreArgs())
+            for k, v in template.items()}
+        reader = ocp.CheckpointManager(
+            self.directory, item_handlers=ocp.PyTreeCheckpointHandler())
+        try:
+            restored = reader.restore(
+                s, args=ocp.args.PyTreeRestore(
+                    item=host_template, restore_args=restore_args))
+        finally:
+            reader.close()
+        out = dict(restored)
+        for k, v in template.items():
+            sharding = (v.sharding if isinstance(v, jax.ShapeDtypeStruct)
+                        else None)
+            if sharding is None:
+                continue
+            arr = np.asarray(restored[k])
+            out[k] = jax.make_array_from_callback(
+                v.shape, sharding, lambda idx, a=arr: a[idx])
+        return out
 
     def _raise_restore_error(self, s, e) -> None:
         # Orbax surfaces config-mismatch as a shape ValueError (whose

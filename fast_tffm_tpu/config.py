@@ -349,8 +349,28 @@ class FmConfig:
     # named-worker diagnosis; "shrink" tears down the distributed
     # client, reforms the cluster from the surviving membership,
     # redistributes the lost worker's input shards, restores from the
-    # last verified checkpoint, and continues.
-    elastic: str = "off"            # "off" | "shrink"
+    # last verified checkpoint, and continues. "grow" implies shrink
+    # AND additionally heals the cluster back toward full capacity:
+    # a replacement launched with `run_tffm.py train <cfg> --join`
+    # publishes a join-request lease in <model_file>.hb/, and the
+    # running cluster admits it at the next safe barrier (epoch
+    # boundary in run_mode = epochs, publish settle in run_mode =
+    # stream) through a generation-bumped reform — the newcomer comes
+    # up through the full durable-state path (verified restore,
+    # chief-broadcast watermark/vocab) and input shards re-balance
+    # over the new membership.
+    elastic: str = "off"            # "off" | "shrink" | "grow"
+    # Elastic GROW rendezvous (elastic = grow): how long a grow reform
+    # waits for every PLANNED joiner to announce + heartbeat before
+    # committing membership without the missing ones — a joiner that
+    # dies mid-rendezvous must never wedge the incumbents. Floored at
+    # runtime by the lease staleness window so a dead joiner is
+    # visibly dead before it is dropped.
+    join_settle_seconds: float = 5.0
+    # The joiner's (`--join`) total budget to be admitted by a running
+    # cluster before giving up with an actionable error.
+    # 0 = use cluster_connect_timeout_seconds.
+    join_timeout_seconds: float = 0.0
 
     def __post_init__(self):
         if self.order < 2:
@@ -617,14 +637,32 @@ class FmConfig:
             raise ValueError(
                 f"heartbeat_seconds must be >= 0 (0 = liveness off), "
                 f"got {self.heartbeat_seconds}")
-        if self.elastic not in ("off", "shrink"):
+        if self.elastic not in ("off", "shrink", "grow"):
             raise ValueError(
-                f"unknown elastic {self.elastic!r} (want off | shrink)")
-        if self.elastic == "shrink" and not self.heartbeat_seconds:
+                f"unknown elastic {self.elastic!r} "
+                "(want off | shrink | grow)")
+        if self.elastic != "off" and not self.heartbeat_seconds:
             raise ValueError(
-                "elastic = shrink requires heartbeat_seconds > 0: "
-                "surviving membership is decided from the heartbeat "
-                "leases in <model_file>.hb/")
+                f"elastic = {self.elastic} requires heartbeat_seconds "
+                "> 0: membership (survivors AND joiners) is decided "
+                "from the heartbeat leases in <model_file>.hb/")
+        if self.join_settle_seconds <= 0:
+            raise ValueError(
+                f"join_settle_seconds must be > 0, got "
+                f"{self.join_settle_seconds}")
+        if self.join_timeout_seconds < 0:
+            raise ValueError(
+                f"join_timeout_seconds must be >= 0 (0 = the "
+                f"cluster_connect budget), got "
+                f"{self.join_timeout_seconds}")
+        if (self.elastic == "grow" and self.run_mode == "stream"
+                and self.publish_interval_seconds <= 0):
+            raise ValueError(
+                "elastic = grow with run_mode = stream requires "
+                "publish_interval_seconds > 0: a streaming cluster "
+                "admits joiners at publish settles (the stream's safe "
+                "barriers) — a never-publishing stream would never "
+                "admit a replacement worker")
         if self.weight_files and not self.train_files:
             # Mirror of the validation_weight_files check above: a
             # sidecar list with nothing to pair against is always a
@@ -767,6 +805,8 @@ _CLUSTER_KEYS = {
     "collective_timeout_seconds": float,
     "heartbeat_seconds": float,
     "elastic": str,
+    "join_settle_seconds": float,
+    "join_timeout_seconds": float,
 }
 
 

@@ -413,6 +413,7 @@ HEALTH_KINDS = frozenset({
     "stalled", "recovered", "nonfinite_loss", "preempted",
     "worker_lost", "elastic_recovered", "ckpt_fallback", "bad_input",
     "collective_slow", "cluster_bringup_failed", "gate_held",
+    "join_refused",
 })
 
 
@@ -454,6 +455,8 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
             if h.get("status") == "collective_slow"]
     bringup = [h for h in health
                if h.get("status") == "cluster_bringup_failed"]
+    refused = [h for h in health
+               if h.get("status") == "join_refused"]
     unclosed = (summary.get("run_starts", 0)
                 > summary.get("run_ends", 0))
     notes = []
@@ -469,6 +472,10 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
     if bringup:
         notes.append("cluster bring-up exhausted its retry budget "
                      "(cluster_bringup_failed)")
+    if refused:
+        notes.append(f"{len(refused)} join_refused event(s) — a "
+                     "joiner was turned away at the grow rendezvous "
+                     "(stale generation, or a slot race lost)")
     unknown = sorted({str(h.get("status", "")) for h in health}
                      - HEALTH_KINDS - {""})
     if unknown:
@@ -505,6 +512,29 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
         n = max(len(lost_ids), 1)
         who = (", ".join(f"process {p}" for p in lost_ids)
                if lost_ids else "unnamed peer(s)")
+        last_el = elastic[-1] if elastic else None
+        cap = (last_el or {}).get("capacity")
+        el_members = (last_el or {}).get("members") or []
+        if last_el is not None and cap and len(el_members) == int(cap):
+            # The LAST elastic event restored FULL membership (grow
+            # healed the cluster, or every "lost" worker rejoined):
+            # rendering DEGRADED here would be actively wrong — the
+            # job finished at capacity. Never silently green though:
+            # the healing story stays in the detail.
+            gen = int(last_el.get("generation", 0))
+            joined = sorted(int(p) for p in
+                            (last_el.get("joined") or []))
+            return {"verdict": f"RECOVERED (gen {gen}, "
+                               f"{len(el_members)} workers)",
+                    "detail": "; ".join(
+                        [f"lost {who}, then elastic recovery x"
+                         f"{len(elastic)} healed the cluster back to "
+                         f"full membership ({len(el_members)}/"
+                         f"{int(cap)} workers"
+                         + (f", replacement(s) {joined} admitted"
+                            if joined else "")
+                         + f") — the run finished at capacity"]
+                        + notes)}
         if elastic:
             gens = max(int(h.get("generation", 0)) for h in elastic)
             members = (elastic[-1].get("members") or [])
@@ -669,6 +699,11 @@ def worker_table(summary: Dict[str, Any]) -> List[str]:
         elif h.get("status") == "elastic_recovered":
             # fmlint: disable=R001 -- parsed JSON event fields
             lost_ids.update(int(p) for p in h.get("lost") or [])
+            # A grow recovery re-admits a slot a shrink once lost:
+            # events are read in stream order, so the replacement's
+            # row (fresh heartbeats and all) drops the LOST flag.
+            # fmlint: disable=R001 -- parsed JSON event fields
+            lost_ids -= {int(p) for p in h.get("joined") or []}
     rows = []
     for proc in sorted(summary.get("gauges_by_process", {})):
         g = summary["gauges_by_process"][proc]

@@ -243,23 +243,34 @@ def emit_worker_lost(lost, label: str,
     tel.sink.flush()
 
 
-def emit_elastic_recovery(generation: int, members,
-                          lost) -> None:
-    """The elastic-shrink success event: survivors reformed into
-    cluster generation ``generation`` with ``members`` (original
-    process indices) after losing ``lost``. fmstat's DEGRADED verdict
-    reads these alongside the worker_lost diagnoses."""
+def emit_elastic_recovery(generation: int, members, lost,
+                          joined=(), capacity=None,
+                          kind: str = "shrink") -> None:
+    """The elastic recovery success event — both directions: survivors
+    reformed into cluster generation ``generation`` with ``members``
+    (original process indices) after losing ``lost`` (shrink), or
+    after admitting ``joined`` replacement workers (grow).
+    ``capacity`` is the original cluster size: fmstat renders
+    ``RECOVERED (gen N, M workers)`` when the LAST recovery restores
+    full membership, DEGRADED otherwise."""
     from fast_tffm_tpu.obs.telemetry import active
     tel = active()
     if tel is None:
         return
     tel.count("cluster/elastic_recoveries")
-    tel.sink.emit("health", {
+    if joined:
+        tel.count("cluster/workers_joined", len(joined))
+    fields = {
         "status": "elastic_recovered",
+        "kind": str(kind),
         "generation": int(generation),
         "members": [int(m) for m in members],
         "lost": [int(p) for p in lost],
-    })
+        "joined": [int(p) for p in joined],
+    }
+    if capacity is not None:
+        fields["capacity"] = int(capacity)
+    tel.sink.emit("health", fields)
     tel.sink.flush()
 
 

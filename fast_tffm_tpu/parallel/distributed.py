@@ -17,6 +17,7 @@ a job that listed N ps hosts simply doesn't start them.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
@@ -390,7 +391,259 @@ def reform_shrunken_cluster(cfg: FmConfig, lease, generation: int,
                       address=coordinator_address(cfg, generation,
                                                   hosts=hosts),
                       num_processes=len(members), process_id=rank)
+    if rank == 0:
+        # Reform-completion litter sweep (chief only): superseded
+        # generations' announce/plan/commit files and departed
+        # members' leases must not accumulate over a long elastic
+        # stream's reforms.
+        from fast_tffm_tpu.parallel.liveness import sweep_lease_dir
+        sweep_lease_dir(lease.directory, generation, members,
+                        join_stale_after=lease.stale_after)
     return rank, len(members), members
+
+
+def reform_grown_cluster(cfg: FmConfig, lease, generation: int,
+                         plan: dict, logger=None
+                         ) -> Tuple[int, int, List[int], int]:
+    """Rebuild the SPMD job with replacement worker(s) admitted
+    (``elastic = grow``) — the inverse of ``reform_shrunken_cluster``,
+    through the same per-generation rendezvous files:
+
+    1. retire the (healthy) distributed client when one exists — the
+       reformed job needs a fresh client against the bumped
+       generation's coordinator either way, and retire is the one
+       teardown that can never stall on a handshake;
+    2. announce readiness for ``generation`` and poll
+       ``grow_rendezvous_step``: incumbents are mandatory, planned
+       joiners optional — a joiner whose worker lease never turns up
+       fresh inside ``join_settle_seconds`` died mid-rendezvous and
+       the reform proceeds WITHOUT it (never wedging the incumbents);
+       announcers the plan never assigned are refused loudly;
+    3. the chief commits the final membership (``commit-<g>.json``);
+       every party adopts it verbatim, so nobody can disagree about
+       ``num_processes``; then form the job at the generation-bumped
+       coordinator port.
+
+    A joiner that dies AFTER the commit but before its connect lands
+    surfaces as the bring-up retry exhausting its budget; the
+    incumbents then fall back to a shrink-style reform at the NEXT
+    generation, which the now-stale joiner drops out of — a bounded
+    detour, not a wedge. Returns ``(rank, num_shards, members,
+    generation)`` — the FINAL generation, which the fallback bumps
+    past the plan's: the caller must adopt it, or the next reform
+    would reuse an already-consumed generation (and its coordinator
+    port, still held by the retired service)."""
+    from fast_tffm_tpu.parallel import liveness as lv
+    log = logger or _silent_logger()
+    import jax
+    if jax.process_count() > 1:
+        retire_distributed_client()
+    lease.announce_reform(generation)
+    budget = getattr(cfg, "cluster_connect_timeout_seconds", 300.0)
+    settle = getattr(cfg, "join_settle_seconds", 5.0)
+    deadline = time.monotonic() + budget
+    join_deadline = time.monotonic() + max(
+        settle, lease.stale_after + lease.heartbeat_seconds)
+    incumbents = [int(i) for i in plan["incumbents"]]
+    chief = lease.process_index == min(incumbents)
+    refused: set = set()
+    while True:
+        now = time.monotonic()
+        members = lv.read_commit(lease.directory, generation)
+        if members is not None:
+            break
+        for slot in lv.unexpected_announcers(lease, plan):
+            if slot not in refused:
+                refused.add(slot)
+                log.warning(
+                    "grow generation %d: refusing announce from slot "
+                    "%d — not in the admission plan (stale generation "
+                    "or slot collision)", generation, slot)
+                if chief:
+                    # Chief-only like the other job-global health
+                    # events: every incumbent sees the same announce
+                    # file, and per-worker shard counters merge by
+                    # SUM — one turned-away process must count once,
+                    # not once per incumbent.
+                    lv.emit_join_refused(generation, slot,
+                                         "announced a generation it "
+                                         "was never planned into")
+        if chief:
+            members = lv.grow_rendezvous_step(lease, plan, now,
+                                              join_deadline)
+            if members is not None:
+                dropped = sorted(
+                    set(int(s) for s in plan["joiners"].values())
+                    - set(members))
+                if dropped:
+                    log.warning(
+                        "grow generation %d: planned joiner slot(s) "
+                        "%s never rendezvoused inside the settle "
+                        "window (died mid-rendezvous?); reforming "
+                        "without them", generation, dropped)
+                lv.write_commit(lease.directory, generation, members)
+                break
+        if now >= deadline:
+            raise RuntimeError(
+                f"elastic grow generation {generation} did not "
+                f"converge within cluster_connect_timeout_seconds="
+                f"{budget:g}s: announced="
+                f"{lease.reform_members(generation)} plan={plan}")
+        time.sleep(min(0.1, max(lease.heartbeat_seconds / 4, 0.02)))
+    if lease.process_index not in members:
+        raise RuntimeError(
+            f"elastic grow generation {generation}: this incumbent "
+            f"({lease.process_index}) is missing from the committed "
+            f"membership {members}")
+    lease.members = tuple(members)
+    rank = members.index(lease.process_index)
+    joined = sorted(set(members) - set(incumbents))
+    log.info("elastic grow generation %d: members %s (admitted %s), "
+             "this process re-ranks %d -> %d of %d", generation,
+             members, joined or "nobody", lease.process_index, rank,
+             len(members))
+    if len(members) > 1:
+        hosts = [cfg.worker_hosts[m] for m in members]
+        try:
+            _join_cluster(cfg,
+                          address=coordinator_address(cfg, generation,
+                                                      hosts=hosts),
+                          num_processes=len(members), process_id=rank)
+        except RuntimeError:
+            stale_joiners = [s for s in joined if not lease.fresh(s)]
+            if not stale_joiners:
+                raise
+            # The committed joiner died between commit and connect:
+            # fall back to a shrink-style reform at the next
+            # generation — live-lease filtering drops it there.
+            log.warning(
+                "grow generation %d bring-up failed with committed "
+                "joiner(s) %s now stale; falling back to a shrink "
+                "reform at generation %d", generation, stale_joiners,
+                generation + 1)
+            rank, n, members = reform_shrunken_cluster(
+                cfg, lease, generation + 1, logger)
+            return rank, n, members, generation + 1
+    if rank == 0:
+        from fast_tffm_tpu.parallel.liveness import sweep_lease_dir
+        sweep_lease_dir(lease.directory, generation, members,
+                        join_stale_after=lease.stale_after)
+    return rank, len(members), members, generation
+
+
+def join_rendezvous(cfg: FmConfig, logger=None
+                    ) -> Tuple[object, int, int, List[int], int, int]:
+    """The replacement process's half of elastic GROW
+    (``run_tffm.py train <cfg> --join``): publish a join ticket in the
+    rendezvous dir, wait for a running cluster's admission plan, then
+    come up through the SAME generation-bumped rendezvous the
+    incumbents use. Returns ``(lease, rank, num_shards, members,
+    generation, slot)`` — from there the elastic driver treats this
+    process exactly like any other member (verified checkpoint
+    restore, chief-broadcast watermark/vocab, shard re-balance all
+    happen in the session it enters).
+
+    Bounded: ``join_timeout_seconds`` (default: the cluster-connect
+    budget) caps the wait for an offer; a commit that EXCLUDES this
+    joiner (it lost a slot race, or announced too late) is refused
+    loudly and the wait resumes for the next opening until the budget
+    runs out."""
+    from fast_tffm_tpu.parallel import liveness as lv
+    log = logger or _silent_logger()
+    directory = lv.lease_dir(cfg)
+    os.makedirs(directory, exist_ok=True)
+    hb = getattr(cfg, "heartbeat_seconds", 5.0)
+    ticket = lv.JoinTicket(directory, heartbeat_seconds=hb).start()
+    budget = (getattr(cfg, "join_timeout_seconds", 0.0)
+              or getattr(cfg, "cluster_connect_timeout_seconds", 300.0))
+    deadline = time.monotonic() + budget
+    poll = min(1.0, max(hb / 4, 0.05))
+    min_generation = 0
+    lease = None
+    log.info("join: ticket %s published in %s; waiting for a running "
+             "cluster's admission plan (budget %gs)", ticket.name,
+             directory, budget)
+    try:
+        while True:
+            plan = lv.grow_plan_for(directory, ticket.name,
+                                    min_generation=min_generation)
+            if plan is not None:
+                g = int(plan["generation"])
+                slot = int(plan["joiners"][ticket.name])
+                committed = lv.read_commit(directory, g)
+                if committed is not None and slot not in committed:
+                    # Stale plan: that generation already closed
+                    # without us. Refuse it loudly and only consider
+                    # NEWER offers from here on.
+                    log.warning(
+                        "join: generation %d committed without this "
+                        "joiner (stale plan); waiting for a fresh "
+                        "offer", g)
+                    min_generation = g + 1
+                    plan = None
+            if plan is not None:
+                hint = sorted({int(i) for i in plan["incumbents"]}
+                              | {int(s)
+                                 for s in plan["joiners"].values()})
+                lease = lv.HeartbeatLease(
+                    directory, process_index=slot, members=hint,
+                    heartbeat_seconds=hb).start()
+                lease.announce_reform(g)
+                log.info("join: announced for cluster generation %d "
+                         "as worker slot %d", g, slot)
+                while True:
+                    committed = lv.read_commit(directory, g)
+                    if committed is not None:
+                        break
+                    if time.monotonic() >= deadline:
+                        raise RuntimeError(
+                            f"join: generation {g} never committed "
+                            f"within join budget {budget:g}s (did the "
+                            "incumbents die mid-rendezvous?)")
+                    time.sleep(poll)
+                if slot not in committed:
+                    log.warning(
+                        "join: commit for generation %d excludes this "
+                        "joiner (slot race lost / announce too late); "
+                        "re-queueing for the next opening", g)
+                    lv.emit_join_refused(g, slot,
+                                         "commit excluded this joiner")
+                    lease.stop()
+                    lease = None
+                    min_generation = g + 1
+                    continue
+                members = committed
+                lease.members = tuple(members)
+                rank = members.index(slot)
+                if len(members) > 1:
+                    hosts = [cfg.worker_hosts[m] for m in members]
+                    _join_cluster(
+                        cfg,
+                        address=coordinator_address(cfg, g,
+                                                    hosts=hosts),
+                        num_processes=len(members), process_id=rank)
+                log.info("join: admitted into generation %d as rank "
+                         "%d of %d (worker slot %d)", g, rank,
+                         len(members), slot)
+                return lease, rank, len(members), members, g, slot
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    f"join: no running cluster admitted this process "
+                    f"within {budget:g}s — is a trainer with elastic "
+                    f"= grow running against "
+                    f"{getattr(cfg, 'model_file', '?')}, with a free "
+                    "worker slot, and reaching its next safe barrier "
+                    "(epoch boundary / publish settle)?")
+            time.sleep(poll)
+    except BaseException:
+        if lease is not None:
+            try:
+                lease.stop()
+            except Exception:
+                pass
+        raise
+    finally:
+        ticket.stop(remove=True)
 
 
 def _silent_logger():

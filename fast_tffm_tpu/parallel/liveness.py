@@ -61,6 +61,23 @@ STALE_FACTOR = 4.0
 # it — absorbs the skew between survivors' guard expiries.
 REFORM_SETTLE_SECONDS = 1.0
 
+# Elastic GROW rendezvous files (all in the same ``<model_file>.hb/``
+# dir as the worker leases — one shared-FS assumption, one sweep):
+#   join-<stamp>-<pid>   a replacement process's join-request lease
+#                        (renewed like a worker lease; lease ORDER is
+#                        the filename sort, the deterministic
+#                        tie-break when joiners race open slots)
+#   grow-<g>.json        the incumbent chief's admission plan for
+#                        cluster generation g (which ticket gets which
+#                        worker slot) — what a joiner polls for
+#   commit-<g>.json      the chief's FINAL membership for generation g,
+#                        written once the settle window resolves; every
+#                        party (incumbent or joiner) adopts it verbatim
+#                        so nobody can disagree about num_processes
+JOIN_PREFIX = "join-"
+GROW_PLAN_PREFIX = "grow-"
+COMMIT_PREFIX = "commit-"
+
 
 class WorkerLostError(RuntimeError):
     """A blocking collective expired (or failed) and the liveness table
@@ -195,6 +212,17 @@ class HeartbeatLease:
         stale = {i.process_index for i in self.stale_peers(now=now)}
         return [p for p in self.members if p not in stale]
 
+    def fresh(self, process_index: int,
+              now: Optional[float] = None) -> bool:
+        """Whether ``worker-<i>.hb`` is on disk and within the
+        staleness threshold — membership-agnostic (the grow rendezvous
+        asks about JOINER slots before they are members)."""
+        if process_index == self.process_index:
+            return True
+        info = self.peer_info(process_index, now=now)
+        return (info.age_seconds is not None
+                and info.age_seconds <= self.stale_after)
+
     # -- elastic reform rendezvous --------------------------------------
     def announce_reform(self, generation: int) -> None:
         """Publish that this process is ready to reform into cluster
@@ -229,6 +257,14 @@ class HeartbeatLease:
         peer whose lease resumes re-arms). Returns the newly-lost
         peers. Called from the daemon loop; tests call it directly
         under a fake clock."""
+        if self.read(self.process_index) is None:
+            # Our OWN lease — renewed this very tick — is unreadable:
+            # the rendezvous dir itself is transiently broken (NFS
+            # blip, permissions flip), not the peers. Reporting every
+            # peer lost off an unreadable dir would be a mass false
+            # positive; skip the tick, staleness re-evaluates next
+            # interval.
+            return []
         stale = self.stale_peers()
         stale_ids = {i.process_index for i in stale}
         fresh = [i for i in stale
@@ -267,8 +303,12 @@ class HeartbeatLease:
         return self
 
     def stop(self, remove: bool = True) -> None:
-        """Stop renewing; ``remove`` drops our lease file so a clean
-        exit doesn't leave a stale lease for the next run to report."""
+        """Stop renewing; ``remove`` drops our lease file — and sweeps
+        any STALE lease left behind by retired/dead members — so a
+        clean exit doesn't leave a lease dir full of ghosts for the
+        next run (or a joiner scanning for a live cluster) to read. A
+        fresh peer lease is never touched: staleness is the same
+        threshold the liveness verdicts use."""
         self._stop.set()
         t = self._thread
         if t is not None:
@@ -279,12 +319,372 @@ class HeartbeatLease:
                 os.remove(self.lease_path(self.process_index))
             except OSError:
                 pass
+            try:
+                names = os.listdir(self.directory)
+            except OSError:
+                return
+            now = self._clock()
+            for n in names:
+                if not (n.startswith("worker-") and n.endswith(".hb")):
+                    continue
+                try:
+                    idx = int(n[len("worker-"):-len(".hb")])
+                except ValueError:
+                    continue
+                if idx == self.process_index:
+                    continue
+                info = self.peer_info(idx, now=now)
+                if (info.age_seconds is None
+                        or info.age_seconds > self.stale_after):
+                    try:
+                        os.remove(os.path.join(self.directory, n))
+                    except OSError:
+                        pass
 
 
 def lease_dir(cfg) -> str:
     """The rendezvous dir for a run: ``<model_file>.hb/`` — a sibling
     of the checkpoint dir, on the same shared filesystem."""
     return os.path.abspath(cfg.model_file) + ".hb"
+
+
+# --- elastic GROW: join tickets + admission plans ------------------------
+#
+# Shrink's mechanisms (generation-bumped reform announcements, live-
+# lease filtering, the settle window) run here in the opposite
+# direction: a replacement process publishes a JOIN TICKET in the
+# rendezvous dir, the running cluster notices it at a safe barrier
+# (epoch boundary / publish settle — train.py owns the trigger), the
+# chief writes an admission PLAN assigning the ticket a free worker
+# slot, and both sides rendezvous through the same per-generation
+# announce files into a reformed cluster that includes the newcomer.
+# The failure half is first-class: a joiner that dies mid-rendezvous
+# is filtered by its lease going stale inside the settle window and
+# the reform COMMITS without it; a joiner announcing into a
+# generation it was never planned into is refused loudly; joiners
+# racing fewer open slots resolve deterministically by ticket order.
+
+
+class JoinTicket:
+    """A replacement process's join-request lease.
+
+    ``join-<stamp>-<pid>`` in the rendezvous dir, renewed on a daemon
+    thread exactly like a worker lease — a joiner that dies stops
+    renewing, so the cluster's admission scan (``pending_join_tickets``)
+    never plans a slot for a ghost. The zero-padded monotonic stamp
+    makes filename sort the deterministic admission order."""
+
+    def __init__(self, directory: str, heartbeat_seconds: float = 5.0,
+                 host: Optional[str] = None, pid: Optional[int] = None,
+                 clock: Callable[[], float] = time.time,
+                 name: Optional[str] = None):
+        self.directory = directory
+        self.heartbeat_seconds = float(heartbeat_seconds)
+        self.host = host if host is not None else socket.gethostname()
+        self.pid = int(pid if pid is not None else os.getpid())
+        self._clock = clock
+        self.name = name or (f"{JOIN_PREFIX}"
+                             f"{int(self._clock() * 1e3):016d}"
+                             f"-{self.pid}")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(self.directory, exist_ok=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, self.name)
+
+    def renew(self) -> None:
+        """Same atomic-rewrite / swallow-OSError contract as
+        HeartbeatLease.renew — one missed beat, never a crash."""
+        tmp = f"{self.path}.tmp.{self.pid}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump({"host": self.host, "pid": self.pid,
+                           "time": self._clock()}, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def start(self) -> "JoinTicket":
+        if self._thread is None and self.heartbeat_seconds > 0:
+            self.renew()
+
+            def loop():
+                while not self._stop.wait(self.heartbeat_seconds):
+                    self.renew()
+            self._thread = threading.Thread(target=loop,
+                                            name="join-ticket",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, remove: bool = True) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+            self._thread = None
+        if remove:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+
+def pending_join_tickets(directory: str, stale_after: float,
+                         now: Optional[float] = None) -> List[str]:
+    """FRESH join-ticket names in deterministic (filename-sorted)
+    order — the cluster's admission scan. A stale or garbled ticket is
+    a dead joiner: never planned for, swept with the generation
+    litter. Unreadable dir reads as 'nobody waiting' (the safe
+    direction: admission is an optimization, never a liveness
+    dependency)."""
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return []
+    now = time.time() if now is None else now
+    out = []
+    for n in names:
+        if not n.startswith(JOIN_PREFIX) or ".tmp." in n:
+            continue
+        try:
+            with open(os.path.join(directory, n),
+                      encoding="utf-8") as fh:
+                rec = json.load(fh)
+            age = now - float(rec["time"])
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+        if age <= stale_after:  # clock skew (age < 0) reads fresh
+            out.append(n)
+    return out
+
+
+def plan_grow(generation: int, members: Sequence[int], capacity: int,
+              tickets: Sequence[str]) -> Optional[Dict]:
+    """The chief's admission decision at a safe barrier: assign fresh
+    join tickets to free ORIGINAL worker slots (the dead workers'
+    indices — re-using them keeps ``worker_hosts`` slot semantics and
+    the fmstat per-worker rows stable), hottest ticket first by
+    filename order. None when there is nothing to do. Deterministic
+    and pure — the multi-worker trigger broadcasts the chief's plan,
+    and two joiners racing one open slot resolve by ticket order, the
+    loser staying pending for the next opening."""
+    free = sorted(set(range(int(capacity))) - {int(m) for m in members})
+    tickets = sorted(tickets)
+    if not free or not tickets:
+        return None
+    return {
+        "generation": int(generation),
+        "incumbents": sorted(int(m) for m in members),
+        "joiners": {t: s for t, s in zip(tickets, free)},
+    }
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh)
+    os.replace(tmp, path)
+
+
+def grow_plan_path(directory: str, generation: int) -> str:
+    return os.path.join(directory,
+                        f"{GROW_PLAN_PREFIX}{int(generation)}.json")
+
+
+def commit_path(directory: str, generation: int) -> str:
+    return os.path.join(directory,
+                        f"{COMMIT_PREFIX}{int(generation)}.json")
+
+
+def write_grow_plan(directory: str, plan: Dict) -> str:
+    path = grow_plan_path(directory, plan["generation"])
+    _atomic_write_json(path, plan)
+    return path
+
+
+def write_commit(directory: str, generation: int,
+                 members: Sequence[int]) -> str:
+    path = commit_path(directory, generation)
+    _atomic_write_json(path, {"generation": int(generation),
+                              "members": [int(m) for m in members]})
+    return path
+
+
+def _read_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def read_grow_plan(directory: str, generation: int) -> Optional[Dict]:
+    plan = _read_json(grow_plan_path(directory, generation))
+    if (not isinstance(plan, dict) or "incumbents" not in plan
+            or not isinstance(plan.get("joiners"), dict)):
+        return None
+    return plan
+
+
+def read_commit(directory: str,
+                generation: int) -> Optional[List[int]]:
+    rec = _read_json(commit_path(directory, generation))
+    if not isinstance(rec, dict) or "members" not in rec:
+        return None
+    try:
+        return sorted(int(m) for m in rec["members"])
+    except (TypeError, ValueError):
+        return None
+
+
+def grow_plan_for(directory: str, ticket_name: str,
+                  min_generation: int = 0) -> Optional[Dict]:
+    """The newest admission plan naming ``ticket_name``, ignoring
+    generations below ``min_generation`` (a refused joiner bumps the
+    floor so a stale plan — litter from a superseded round — is never
+    acted on twice). What the joiner's wait loop polls."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    gens = []
+    for n in names:
+        if n.startswith(GROW_PLAN_PREFIX) and n.endswith(".json"):
+            try:
+                gens.append(int(n[len(GROW_PLAN_PREFIX):-len(".json")]))
+            except ValueError:
+                pass
+    for g in sorted(gens, reverse=True):
+        if g < min_generation:
+            break
+        plan = read_grow_plan(directory, g)
+        if plan is not None and ticket_name in plan["joiners"]:
+            return plan
+    return None
+
+
+def grow_rendezvous_step(lease: HeartbeatLease, plan: Dict,
+                         now_monotonic: float,
+                         join_deadline: float) -> Optional[List[int]]:
+    """One tick of the incumbent chief's grow settle loop: the final
+    membership once it is decidable, else None (keep polling).
+
+    Committable once every incumbent has announced the plan's
+    generation AND the join settle window (``join_deadline``) has
+    fully elapsed — the window is never cut short, even with every
+    planned joiner already announced, because staleness is the ONLY
+    death signal and it lags a death by the staleness threshold: a
+    joiner that announced and died a breath later must be visibly
+    stale by commit time (``join_settle_seconds`` is floored at the
+    staleness window for exactly this). At the deadline each planned
+    slot is in (announced with a FRESH worker lease) or out (missing
+    or stale: it died mid-rendezvous, and must never wedge the
+    incumbents). Clock-injectable through the lease; tests drive it
+    directly."""
+    g = int(plan["generation"])
+    announced = set(lease.reform_members(g))
+    incumbents = [int(i) for i in plan["incumbents"]]
+    if not set(incumbents) <= announced:
+        return None
+    optional = sorted(int(s) for s in plan["joiners"].values())
+    if optional and now_monotonic < join_deadline:
+        return None
+    joined = [s for s in optional
+              if s in announced and lease.fresh(s)]
+    return sorted(set(incumbents) | set(joined))
+
+
+def unexpected_announcers(lease: HeartbeatLease,
+                          plan: Dict) -> List[int]:
+    """Announce files for the plan's generation from slots the plan
+    never assigned — a joiner acting on a stale plan, or a slot
+    collision. The reform ignores them for membership; the caller
+    refuses them LOUDLY (``health: join_refused``) so the operator
+    sees the turned-away process instead of wondering why it idles."""
+    g = int(plan["generation"])
+    expected = ({int(i) for i in plan["incumbents"]}
+                | {int(s) for s in plan["joiners"].values()})
+    return sorted(set(lease.reform_members(g)) - expected)
+
+
+def sweep_lease_dir(directory: str, generation: int,
+                    members: Sequence[int],
+                    join_stale_after: float = 0.0,
+                    now: Optional[float] = None) -> int:
+    """Reform-completion litter sweep: per-generation announce files,
+    plans, and commits of SUPERSEDED generations, lease files of
+    processes no longer in the membership, and dead (stale/garbled)
+    join tickets — a long-lived elastic stream must not grow the
+    rendezvous dir forever. Current-generation files and fresh join
+    tickets (joiners still waiting for a future opening) survive.
+    Returns the number of files removed; never raises."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    keep = {int(m) for m in members}
+    fresh_tickets = (set(pending_join_tickets(directory,
+                                              join_stale_after,
+                                              now=now))
+                     if join_stale_after > 0 else set())
+    removed = 0
+    for n in names:
+        drop = False
+        if ".tmp." in n:
+            drop = True
+        elif n.startswith("reform-"):
+            try:
+                drop = int(n.split("-")[1]) < int(generation)
+            except (IndexError, ValueError):
+                drop = True
+        elif (n.startswith(GROW_PLAN_PREFIX)
+              or n.startswith(COMMIT_PREFIX)) and n.endswith(".json"):
+            prefix = (GROW_PLAN_PREFIX if n.startswith(GROW_PLAN_PREFIX)
+                      else COMMIT_PREFIX)
+            try:
+                drop = int(n[len(prefix):-len(".json")]) < int(generation)
+            except ValueError:
+                drop = True
+        elif n.startswith("worker-") and n.endswith(".hb"):
+            try:
+                drop = int(n[len("worker-"):-len(".hb")]) not in keep
+            except ValueError:
+                drop = True
+        elif n.startswith(JOIN_PREFIX):
+            drop = n not in fresh_tickets
+        if drop:
+            try:
+                os.remove(os.path.join(directory, n))
+                removed += 1
+            except OSError:
+                pass
+    return removed
+
+
+def emit_join_refused(generation: int, slot, reason: str) -> None:
+    """Loud refusal of a joiner the rendezvous turned away (stale
+    generation announce, commit that excluded it): a ``health:
+    join_refused`` event + counter on the active stream, flushed — the
+    refused process idles away outside the cluster, so the evidence
+    must not wait for a barrier. No-op without an active run."""
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    if tel is None:
+        return
+    tel.count("cluster/joins_refused")
+    tel.sink.emit("health", {
+        "status": "join_refused",
+        "generation": int(generation),
+        "slot": int(slot) if slot is not None else -1,
+        "reason": str(reason)[:200],
+    })
+    tel.sink.flush()
 
 
 # --- the guard -----------------------------------------------------------

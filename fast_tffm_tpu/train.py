@@ -219,8 +219,62 @@ def evaluate_distributed(cfg: FmConfig, table: jax.Array, files, mesh,
     return merged.result(), n_total
 
 
+class ClusterGrowth(Exception):
+    """Control-flow signal out of ``_train_session`` at a safe barrier
+    (epoch boundary / publish settle): the chief planned admission of
+    replacement worker(s) — ``plan`` is the ``liveness.plan_grow``
+    payload — and the barrier state is durably saved, so the elastic
+    driver can tear the session down cleanly and reform the grown
+    cluster. NOT an error: it must never be recorded as a crash."""
+
+    def __init__(self, plan: dict):
+        super().__init__(f"cluster growth planned: generation "
+                         f"{plan.get('generation')}")
+        self.plan = plan
+
+
+class _GrowContext:
+    """Driver-owned elastic-grow state threaded into the session
+    (``elastic = grow``): the CURRENT membership + generation (which
+    only the driver's reforms move) and the safe-barrier admission
+    check. ``capacity`` is the original cluster size — joiners fill
+    the ORIGINAL indices of departed workers, so a healed cluster is
+    indistinguishable from one that never shrank."""
+
+    def __init__(self, cfg: FmConfig, lease, members, generation: int):
+        self.cfg = cfg
+        self.lease = lease
+        self.members = tuple(int(m) for m in members)
+        self.generation = int(generation)
+        self.capacity = max(len(cfg.worker_hosts), 1)
+
+    def adopt(self, members, generation: int) -> None:
+        self.members = tuple(int(m) for m in members)
+        self.generation = int(generation)
+
+    def check_barrier(self) -> Optional[dict]:
+        """The admission check every safe barrier runs: fresh join
+        tickets against free original slots -> the next generation's
+        plan, or None. Every process runs the same scan and the
+        chief's answer is broadcast (identity single-process), so a
+        ticket appearing mid-scan can never diverge the cluster —
+        all workers raise ClusterGrowth together or nobody does."""
+        if self.lease is None or len(self.members) >= self.capacity:
+            return None
+        from fast_tffm_tpu.parallel import liveness as lv
+        tickets = lv.pending_join_tickets(self.lease.directory,
+                                          self.lease.stale_after)
+        plan = lv.plan_grow(self.generation + 1, self.members,
+                            self.capacity, tickets)
+        if jax.process_count() > 1:
+            from fast_tffm_tpu.data.stream import broadcast_blob
+            plan = broadcast_blob(plan, "cluster/grow_decision")
+        return plan
+
+
 def train(cfg: FmConfig, job_name: Optional[str] = None,
-          task_index: Optional[int] = None) -> jax.Array:
+          task_index: Optional[int] = None,
+          join: bool = False) -> jax.Array:
     """Run training per config; returns the final table (host-fetchable).
 
     ``job_name``/``task_index`` mirror the reference's ``dist_train``
@@ -240,21 +294,52 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     restores from the last verified checkpoint and redistributes the
     lost worker's input shards by re-sharding over the shrunken
     membership. With ``elastic = off`` the error (naming the dead
-    peers) propagates: fail fast, never hang."""
+    peers) propagates: fail fast, never hang.
+
+    ``elastic = grow`` adds the healing direction: the session checks
+    for join-request leases at every safe barrier and raises
+    ``ClusterGrowth`` (after durably saving the barrier state) when a
+    replacement can be admitted — the driver reforms the GROWN cluster
+    and re-enters, and the newcomer restores through the same verified
+    checkpoint + chief-broadcast path every member uses.
+
+    ``join = True`` is the replacement process itself
+    (``run_tffm.py train <cfg> --join``): it rendezvouses into a
+    running cluster FIRST (its worker slot is unknown until admitted),
+    then runs this same driver loop as an ordinary member."""
     from fast_tffm_tpu.parallel.liveness import (
         HeartbeatLease, WorkerLostError, install_guard, lease_dir,
         restore_guard)
     logger = get_logger(log_file=cfg.log_file or None)
+    join_info = None
+    if join:
+        if cfg.elastic != "grow":
+            raise ValueError(
+                "train --join requires elastic = grow in [Cluster]: "
+                "the running cluster only scans for join tickets when "
+                "grow is on")
+        if job_name is not None:
+            raise ValueError("train --join replaces the dist_train "
+                             "role argv: the worker slot is assigned "
+                             "by the running cluster, not the launcher")
+        from fast_tffm_tpu.parallel.distributed import join_rendezvous
+        # Admission BEFORE telemetry: the metrics shard is keyed by
+        # the worker slot the cluster assigns, which does not exist
+        # until the rendezvous commits.
+        join_info = join_rendezvous(cfg, logger)
     # Telemetry BEFORE the cluster join, keyed by the launcher-assigned
     # task index (jax.process_index() is not valid yet): a job that
     # never forms still writes its `health: cluster_bringup_failed`
     # post-mortem into the stream, and elastic recoveries later stay
     # inside this one run segment.
     tel = make_telemetry(cfg, "train",
-                         process_index=(task_index or 0)
-                         if job_name is not None else None,
+                         process_index=(join_info[5] if join_info
+                                        else (task_index or 0))
+                         if (job_name is not None or join_info)
+                         else None,
                          process_count=max(len(cfg.worker_hosts), 1)
-                         if job_name is not None else None)
+                         if (job_name is not None or join_info)
+                         else None)
     if tel is not None:
         logger.info(
             "writing run metrics to %s (flush every %s steps; summarize "
@@ -276,10 +361,22 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     guard_installed = False
     try:
         shard_index, num_shards = 0, 1
-        if job_name is not None:
+        generation = 0
+        members = [0]
+        if join_info is not None:
+            lease, shard_index, num_shards, members, generation, _ = \
+                join_info
+            if tel is not None:
+                tel.lease = lease
+                tel.sink.meta.update(
+                    backend=jax.default_backend(),
+                    device_count=jax.device_count(),
+                    process_count=jax.process_count())
+        elif job_name is not None:
             from fast_tffm_tpu.parallel.distributed import init_from_cluster
             shard_index, num_shards = init_from_cluster(cfg, job_name,
                                                         task_index or 0)
+            members = list(range(num_shards))
             if tel is not None:
                 # The meta was stamped pre-join with the LOCAL backend
                 # view (deliberate: bring-up failures must land in the
@@ -289,7 +386,8 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     backend=jax.default_backend(),
                     device_count=jax.device_count(),
                     process_count=jax.process_count())
-        if num_shards > 1 and cfg.heartbeat_seconds > 0:
+        if (join_info is None and num_shards > 1
+                and cfg.heartbeat_seconds > 0):
             lease = HeartbeatLease(
                 lease_dir(cfg), process_index=shard_index,
                 members=range(num_shards),
@@ -300,14 +398,71 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
             guard_prev = install_guard(
                 lease, cfg.collective_timeout_seconds)
             guard_installed = True
-        generation = 0
+        grow_ctx = (_GrowContext(cfg, lease, members, generation)
+                    if cfg.elastic == "grow" and lease is not None
+                    else None)
         while True:
             try:
                 return _train_session(cfg, logger, tel, bad_tracker,
-                                      shard_index, num_shards)
+                                      shard_index, num_shards,
+                                      grow_ctx=grow_ctx)
+            except ClusterGrowth as g:
+                # fmlint: disable=R001 -- plan fields are parsed JSON
+                # host values (liveness.plan_grow), never device arrays
+                generation = int(g.plan["generation"])
+                # fmlint: disable=R001 -- same host-JSON plan fields
+                planned = sorted(int(s)
+                                 for s in g.plan["joiners"].values())
+                logger.info(
+                    "elastic grow: admitting joiner(s) %s into "
+                    "cluster generation %d (barrier state saved)",
+                    planned, generation)
+                # Disarm the deadline sentinel like the shrink path:
+                # no guarded collective completes during a reform.
+                if guard_installed:
+                    restore_guard(guard_prev)
+                    guard_installed = False
+                from fast_tffm_tpu.parallel import liveness as lv
+                from fast_tffm_tpu.parallel.distributed import (
+                    reform_grown_cluster)
+                try:
+                    if num_shards <= 1 or jax.process_index() == 0:
+                        # The plan file is what the JOINER polls for —
+                        # the incumbents already share it (chief-
+                        # broadcast at the barrier).
+                        lv.write_grow_plan(lease.directory, g.plan)
+                    # The returned generation is authoritative: the
+                    # dead-committed-joiner fallback reforms one past
+                    # the plan's, and reusing a consumed generation
+                    # would collide with its still-bound coordinator
+                    # port on the next reform.
+                    shard_index, num_shards, members, generation = \
+                        reform_grown_cluster(cfg, lease, generation,
+                                             g.plan, logger)
+                except BaseException as re:
+                    _record_crash(tel, logger, re)
+                    raise
+                grow_ctx.adopt(members, generation)
+                from fast_tffm_tpu.obs.health import (
+                    emit_elastic_recovery)
+                # fmlint: disable=R001 -- host-JSON plan fields
+                incumbents = {int(i) for i in g.plan["incumbents"]}
+                joined = sorted(set(members) - incumbents)
+                emit_elastic_recovery(
+                    generation, members, lost=[], joined=joined,
+                    capacity=grow_ctx.capacity, kind="grow")
+                logger.info(
+                    "elastic recovery complete: %d member(s) "
+                    "(admitted %s), input shards re-balanced, "
+                    "resuming from the last verified checkpoint",
+                    num_shards, joined or "nobody")
+                if num_shards > 1:
+                    guard_prev = install_guard(
+                        lease, cfg.collective_timeout_seconds)
+                    guard_installed = True
             except WorkerLostError as e:
-                if (cfg.elastic != "shrink" or num_shards <= 1
-                        or lease is None):
+                if (cfg.elastic not in ("shrink", "grow")
+                        or num_shards <= 1 or lease is None):
                     _record_crash(tel, logger, e)
                     # Fail FAST: retire (never shutdown — its barrier
                     # cannot complete with a dead peer) so interpreter
@@ -339,7 +494,11 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     _record_crash(tel, logger, re)
                     raise
                 from fast_tffm_tpu.obs.health import emit_elastic_recovery
-                emit_elastic_recovery(generation, members, lost_ids)
+                emit_elastic_recovery(
+                    generation, members, lost_ids,
+                    capacity=max(len(cfg.worker_hosts), 1))
+                if grow_ctx is not None:
+                    grow_ctx.adopt(members, generation)
                 logger.info(
                     "elastic recovery complete: %d survivor(s), input "
                     "shards redistributed, resuming from the last "
@@ -350,10 +509,13 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     guard_prev = install_guard(
                         lease, cfg.collective_timeout_seconds)
                     guard_installed = True
-                else:
+                elif grow_ctx is None:
                     # Lone survivor: no peers left to guard against;
                     # stop the lease so the next multi-worker run in
                     # this rendezvous dir starts from a clean table.
+                    # (elastic = grow keeps it: joiners verify
+                    # incumbent liveness through it, and the grow
+                    # barrier scan reads join tickets beside it.)
                     lease.stop()
                     if tel is not None:
                         tel.lease = None
@@ -403,14 +565,18 @@ def _record_crash(tel, logger, e: BaseException, step: int = -1) -> None:
 
 
 def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
-                   shard_index: int, num_shards: int) -> jax.Array:
+                   shard_index: int, num_shards: int,
+                   grow_ctx=None) -> jax.Array:
     """One training session against the CURRENT cluster membership:
     mesh build, checkpoint restore, the epoch/step loop, and the final
     save/export. Raises ``WorkerLostError`` out of any guarded
     collective when a peer dies — the elastic driver (``train``) owns
-    what happens next. Everything created here (checkpoint manager,
-    summaries, signal handlers, profiler) is torn down here, so the
-    driver can safely re-enter after a recovery."""
+    what happens next — and ``ClusterGrowth`` out of a safe barrier
+    when ``grow_ctx`` plans an admission (the barrier state is saved
+    first, so the newcomer restores exactly this point). Everything
+    created here (checkpoint manager, summaries, signal handlers,
+    profiler) is torn down here, so the driver can safely re-enter
+    after a recovery."""
     spec = ModelSpec.from_config(cfg)
     multi_process = jax.process_count() > 1
     stream_mode = getattr(cfg, "run_mode", "epochs") == "stream"
@@ -961,6 +1127,15 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             from fast_tffm_tpu.data import stream as streamlib
             from fast_tffm_tpu.data.pipeline import empty_batch
             restored_wm = (restored or {}).get("stream")
+            # Seed the adopted position from the restored sidecar: a
+            # recovered session (elastic shrink/grow, preempt-resume)
+            # saves at its restored step BEFORE any new batch steps —
+            # publish settles fire on idle ticks — and an empty
+            # in-memory watermark there would REWRITE the step's
+            # sidecar to empty, wiping the durable position and
+            # double-training the whole consumed prefix after the
+            # next restore (caught by the kill-then-grow soak).
+            stream_watermark = restored_wm
             if restored is not None and restored_wm is None:
                 logger.warning(
                     "restored checkpoint at step %d carries no stream "
@@ -1125,6 +1300,19 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                                   time.perf_counter() - t_pub)
                 last_publish[0] = time.monotonic()
                 stream_gauges()
+                if grow_ctx is not None and not gate_holding[0]:
+                    # The publish settle IS the grow barrier in stream
+                    # mode (the same sync point the vocab barrier
+                    # rides): the save above just landed with the
+                    # merged watermark (wait=True), so a newcomer's
+                    # verified restore resumes the stream exactly-once
+                    # from this point. A HELD publish skipped the save
+                    # — no durable barrier state, no admission; the
+                    # chief-broadcast hold decision keeps every worker
+                    # on the same arm.
+                    plan = grow_ctx.check_barrier()
+                    if plan is not None:
+                        raise ClusterGrowth(plan)
 
             # fmlint: disable=R003 -- anchors the stream step-seconds
             # window (always-on aggregate)
@@ -1171,6 +1359,13 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # checkpointed admission state and the stream
                     # position describe the same prefix.
                     vocab.note_trained(batch)
+                # Log-line rate: the job-global estimate (x P assumes
+                # symmetric shards — exact under line sharding, an
+                # estimate under whole-file stream ownership). The
+                # COUNTER is this worker's OWN real examples: shard
+                # files merge by sum, so anything else would inflate
+                # the exactly-once accounting P-fold (and whole-file
+                # ownership pays fillers as phantom examples).
                 n_global = batch.num_real * (jax.process_count()
                                              if multi_process else 1)
                 timer.tick(n_global)
@@ -1178,7 +1373,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # fmlint: disable=R003 -- feeds the train/
                     # step_seconds histogram (always-on aggregate)
                     now = time.perf_counter()
-                    tel.train_step(now - t_prev[0], n_global,
+                    tel.train_step(now - t_prev[0], batch.num_real,
                                    h2d_bytes)
                     t_prev[0] = now
                     tel.heartbeat(global_step)
@@ -1481,6 +1676,9 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 if vocab is not None:
                     vocab.note_trained(batch)  # adopt-on-step: only
                     # TRAINED batches feed the admission sketch
+                # Counter = LOCAL real examples (shard files merge by
+                # sum — see the stream loop's note); n_global feeds
+                # only the log-line rate estimate.
                 n_global = batch.num_real * (jax.process_count()
                                              if multi_process else 1)
                 timer.tick(n_global)
@@ -1493,7 +1691,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # step_seconds histogram (always-on aggregate; the
                     # train/step span is the timeline view)
                     now = time.perf_counter()
-                    tel.train_step(now - t_step_prev, n_global,
+                    tel.train_step(now - t_step_prev, batch.num_real,
                                    h2d_bytes)
                     t_step_prev = now
                     # Watchdog progress beat: one tuple assignment
@@ -1660,6 +1858,32 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 tel.barrier_flush(global_step)
             if not stopping:  # a preemption-cut epoch is NOT completed
                 completed_epochs = epoch + 1
+            if (grow_ctx is not None and not stopping
+                    and completed_epochs < cfg.epoch_num):
+                # The epoch boundary IS the grow barrier in epochs
+                # mode: every worker is synchronized here (the same
+                # point the vocab barrier uses), and the chief's
+                # admission plan is broadcast so everyone raises
+                # together or nobody does. The barrier state is saved
+                # durably FIRST (force rewrites a same-step periodic
+                # save with the completed epoch count) — it is exactly
+                # what the newcomer's verified restore comes up on.
+                # The last epoch never grows: the run is about to
+                # finish, and a reform would only delay its exit.
+                plan = grow_ctx.check_barrier()
+                if plan is not None:
+                    state = (lk.state() if offload
+                             else ckpt_state(cfg, table, acc))
+                    ckpt.save(global_step, *state,
+                              vocabulary_size=cfg.vocabulary_size,
+                              force=True, wait=True,
+                              epoch=completed_epochs,
+                              vocab_state=(vocab.state_payload()
+                                           if vocab is not None
+                                           else None))
+                    last_periodic_save = (global_step,
+                                          completed_epochs)
+                    raise ClusterGrowth(plan)
         flush_log()
         loss_val = float(loss) if loss is not None else loss_val
         # The final save IS a barrier point (vocab/table.py's contract):
@@ -1785,7 +2009,10 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
         # to re-raise instead.
         from fast_tffm_tpu.parallel.liveness import WorkerLostError
         worker_lost = isinstance(e, WorkerLostError)
-        if not worker_lost:
+        if not worker_lost and not isinstance(e, ClusterGrowth):
+            # ClusterGrowth is a planned, durably-saved barrier exit —
+            # the driver reforms and re-enters; branding it a crash
+            # would flip every healed run's verdict to CRASHED.
             _record_crash(tel, logger, e, global_step)
         raise
     finally:

@@ -4,6 +4,7 @@
 
     python run_tffm.py train   <cfg>
     python run_tffm.py train   <cfg> dist_train <job_name> <task_index>
+    python run_tffm.py train   <cfg> --join
     python run_tffm.py predict <cfg>
     python run_tffm.py predict <cfg> dist_train <job_name> <task_index>
     python run_tffm.py serve   <cfg>
@@ -21,6 +22,14 @@ scorer: it loads the ``published`` checkpoint step, micro-batches
 concurrent requests behind a stdlib HTTP front end (POST /score, GET
 /healthz on ``serve_port``), and hot-reloads when the pointer moves.
 SIGTERM/SIGINT drain and exit cleanly.
+
+``train --join`` (an extension; README "Elastic multi-host") launches
+a REPLACEMENT worker for a running ``elastic = grow`` cluster: it
+publishes a join-request lease in ``<model_file>.hb/``, waits for the
+cluster to admit it at a safe barrier, and comes up as an ordinary
+member — verified checkpoint restore, re-balanced input shards and
+all. Its worker slot is assigned by the cluster, so no task index is
+given.
 """
 
 from __future__ import annotations
@@ -104,6 +113,15 @@ def main(argv=None) -> int:
         return run_serve(cfg)
 
     job_name = task_index = None
+    join = False
+    if rest == ["--join"]:
+        if mode != "train":
+            print("--join is a train mode: a replacement worker joins "
+                  "a running elastic = grow training cluster",
+                  file=sys.stderr)
+            return _usage()
+        join = True
+        rest = []
     if rest:
         if len(rest) != 3 or rest[0] != "dist_train":
             return _usage()
@@ -130,7 +148,7 @@ def main(argv=None) -> int:
         return 0
 
     from fast_tffm_tpu.train import train
-    train(cfg, job_name, task_index)
+    train(cfg, job_name, task_index, join=join)
     return 0
 
 
@@ -150,6 +168,20 @@ def _exit(rc: int) -> "None":
     except Exception:
         retired = False
     if retired:
+        try:
+            # A RETIRED client's teardown is skipped (dead cluster,
+            # doomed handshake) — but an elastic GROW may have formed
+            # a LIVE cluster since (incumbents retire the old client,
+            # then rejoin with the newcomers). That healthy client's
+            # coordination service must be shut down with the proper
+            # handshake, or os._exit below would tear it out from
+            # under the peers mid-teardown — their error poll then
+            # LOG(FATAL)-aborts an otherwise clean exit on THEIR side.
+            import jax
+            if jax.process_count() > 1:
+                jax.distributed.shutdown()
+        except Exception:
+            pass  # a half-formed live client must not block the exit
         import logging
         logging.shutdown()
         sys.stdout.flush()

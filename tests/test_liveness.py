@@ -453,22 +453,360 @@ def test_worker_gauges_ride_metrics_flush(tmp_path):
     assert worker_table(summary)
 
 
+# --- elastic GROW: join tickets + rendezvous (fake clock) -----------------
+
+
+def _ticket(tmp_path, clock, name, t=None):
+    """Write a join ticket record by hand (the fake-clock tests never
+    start the renew thread)."""
+    tk = lv.JoinTicket(str(tmp_path / "hb"), heartbeat_seconds=1.0,
+                       clock=clock, name=name, pid=500)
+    if t is not None:
+        old = clock.t
+        clock.t = t
+        tk.renew()
+        clock.t = old
+    else:
+        tk.renew()
+    return tk
+
+
+def test_pending_join_tickets_freshness_and_order(tmp_path):
+    clock = FakeClock()
+    hb = str(tmp_path / "hb")
+    _ticket(tmp_path, clock, "join-0002-b")
+    _ticket(tmp_path, clock, "join-0001-a")
+    stale = _ticket(tmp_path, clock, "join-0000-dead", t=clock.t - 60)
+    assert stale  # written, but 60s old vs a 20s threshold below
+    (tmp_path / "hb" / "join-0003-torn").write_text("{garb")
+    got = lv.pending_join_tickets(hb, stale_after=20.0, now=clock.t)
+    # fresh tickets only, DETERMINISTIC filename order (the slot-race
+    # tie-break), dead/garbled never planned for
+    assert got == ["join-0001-a", "join-0002-b"]
+    assert lv.pending_join_tickets(str(tmp_path / "nodir"), 20.0) == []
+
+
+def test_plan_grow_two_joiners_race_one_slot():
+    plan = lv.plan_grow(2, members=[0], capacity=2,
+                        tickets=["join-0009-late", "join-0001-first"])
+    # one free slot (1), first ticket BY NAME wins it; the loser stays
+    # unplanned (pending for a future opening)
+    assert plan == {"generation": 2, "incumbents": [0],
+                    "joiners": {"join-0001-first": 1}}
+    # both free: both admitted, filename order maps to slot order
+    plan = lv.plan_grow(3, members=[2], capacity=3,
+                        tickets=["join-b", "join-a"])
+    assert plan["joiners"] == {"join-a": 0, "join-b": 1}
+    assert lv.plan_grow(1, [0, 1], 2, ["join-x"]) is None  # no slot
+    assert lv.plan_grow(1, [0], 2, []) is None             # no ticket
+
+
+def test_grow_rendezvous_joiner_appears_mid_settle_window(tmp_path):
+    """The happy path, tick by tick: the incumbent announces, the
+    joiner's announce + fresh lease appear MID settle window — the
+    commit still waits the window out (staleness is the only death
+    signal, so an early commit could adopt a just-died joiner), then
+    lands WITH the joiner."""
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0, members=(0,))
+    me.renew()
+    plan = {"generation": 2, "incumbents": [0],
+            "joiners": {"join-t": 1}}
+    me.announce_reform(2)
+    # joiner not announced yet: undecidable inside the window
+    assert lv.grow_rendezvous_step(me, plan, now_monotonic=0.0,
+                                   join_deadline=10.0) is None
+    # joiner lands mid-window: announce + a fresh worker-1 lease —
+    # still None (the window must fully elapse) ...
+    joiner = _lease(tmp_path, clock, index=1, members=(0, 1))
+    joiner.renew()
+    joiner.announce_reform(2)
+    assert lv.grow_rendezvous_step(me, plan, now_monotonic=5.0,
+                                   join_deadline=10.0) is None
+    # ... and at the deadline the still-fresh joiner is IN.
+    assert lv.grow_rendezvous_step(me, plan, now_monotonic=10.0,
+                                   join_deadline=10.0) == [0, 1]
+
+
+def test_grow_rendezvous_joiner_dies_mid_rendezvous(tmp_path):
+    """A joiner that announced and then died (lease gone stale) is
+    dropped once the settle window expires — the incumbents commit
+    WITHOUT it instead of wedging."""
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0, members=(0,))
+    me.renew()
+    me.announce_reform(3)
+    joiner = _lease(tmp_path, clock, index=1, members=(0, 1))
+    joiner.renew()
+    joiner.announce_reform(3)
+    plan = {"generation": 3, "incumbents": [0],
+            "joiners": {"join-t": 1}}
+    clock.t += 30.0  # joiner stops renewing: stale (threshold 20s)
+    me.renew()
+    # inside the window: keep waiting (it might be a slow renewal)
+    assert lv.grow_rendezvous_step(me, plan, now_monotonic=1.0,
+                                   join_deadline=10.0) is None
+    # window expired: proceed without the dead joiner
+    assert lv.grow_rendezvous_step(me, plan, now_monotonic=10.0,
+                                   join_deadline=10.0) == [0]
+    # a joiner that never even announced resolves the same way
+    plan2 = {"generation": 3, "incumbents": [0],
+             "joiners": {"join-u": 2}}
+    assert lv.grow_rendezvous_step(me, plan2, now_monotonic=11.0,
+                                   join_deadline=10.0) == [0]
+
+
+def test_grow_rendezvous_stale_generation_announce_refused(tmp_path):
+    """An announce from a slot the plan never assigned (a joiner
+    acting on a stale generation's plan, or a slot collision) is
+    excluded from membership and refused LOUDLY — a health event the
+    operator can see, not a silent idle process."""
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0, members=(0,))
+    me.renew()
+    me.announce_reform(4)
+    stranger = _lease(tmp_path, clock, index=3, members=(0, 3))
+    stranger.renew()
+    stranger.announce_reform(4)  # never in the plan below
+    plan = {"generation": 4, "incumbents": [0], "joiners": {}}
+    assert lv.unexpected_announcers(me, plan) == [3]
+    # membership never includes the stranger
+    assert lv.grow_rendezvous_step(me, plan, now_monotonic=20.0,
+                                   join_deadline=10.0) == [0]
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+    with activate(tel):
+        lv.emit_join_refused(4, 3, "announced a generation it was "
+                             "never planned into")
+    tel.close()
+    ev = [e for e in _events(path) if e.get("status") == "join_refused"]
+    assert len(ev) == 1 and ev[0]["slot"] == 3
+    assert ev[0]["generation"] == 4
+
+
+def test_grow_plan_commit_round_trip_and_stale_floor(tmp_path):
+    hb = str(tmp_path / "hb")
+    (tmp_path / "hb").mkdir()
+    plan = {"generation": 2, "incumbents": [0],
+            "joiners": {"join-t": 1}}
+    lv.write_grow_plan(hb, plan)
+    assert lv.read_grow_plan(hb, 2) == plan
+    assert lv.read_grow_plan(hb, 9) is None
+    assert lv.grow_plan_for(hb, "join-t") == plan
+    assert lv.grow_plan_for(hb, "join-other") is None
+    # a refused joiner bumps its generation floor: the stale plan is
+    # never acted on twice
+    assert lv.grow_plan_for(hb, "join-t", min_generation=3) is None
+    assert lv.read_commit(hb, 2) is None
+    lv.write_commit(hb, 2, [0, 1])
+    assert lv.read_commit(hb, 2) == [0, 1]
+    (tmp_path / "hb" / "commit-3.json").write_text("{torn")
+    assert lv.read_commit(hb, 3) is None
+
+
+def test_unreadable_lease_dir_monitor_tick(tmp_path):
+    """A transiently unreadable rendezvous dir must not turn a monitor
+    tick into a mass false 'everyone is lost' diagnosis (our OWN just-
+    renewed lease being unreadable is the tell that the DIR is the
+    problem), and the admission scan reads it as 'nobody waiting'."""
+    import shutil
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0)
+    peer = _lease(tmp_path, clock, index=1)
+    me.renew()
+    peer.renew()
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={})
+    with activate(tel):
+        shutil.rmtree(me.directory)  # the whole dir vanishes
+        assert me.check_peers() == []  # no spurious worker_lost
+        assert lv.pending_join_tickets(me.directory, 20.0) == []
+    tel.close()
+    assert not [e for e in _events(path)
+                if e.get("status") == "worker_lost"]
+
+
+def test_sweep_lease_dir_keeps_only_current_generation(tmp_path):
+    """N reforms leave only current-generation files: superseded
+    announce/plan/commit files, departed members' leases, and dead
+    join tickets all go; the live membership's leases, the current
+    generation's files, and FRESH tickets stay."""
+    clock = FakeClock()
+    hb = tmp_path / "hb"
+    me = _lease(tmp_path, clock, index=0, members=(0, 1))
+    me.renew()
+    joiner = _lease(tmp_path, clock, index=1, members=(0, 1))
+    joiner.renew()
+    dead = _lease(tmp_path, clock, index=2, members=(0, 1, 2))
+    dead.renew()
+    for g in (1, 2, 3):
+        me.announce_reform(g)
+        lv.write_grow_plan(str(hb), {"generation": g, "incumbents": [0],
+                                     "joiners": {}})
+        lv.write_commit(str(hb), g, [0])
+    _ticket(tmp_path, clock, "join-0009-fresh")
+    _ticket(tmp_path, clock, "join-0001-dead", t=clock.t - 999)
+    (hb / "worker-0.hb.tmp.77").write_text("torn")
+    removed = lv.sweep_lease_dir(str(hb), generation=3, members=[0, 1],
+                                 join_stale_after=20.0, now=clock.t)
+    assert removed > 0
+    left = sorted(p.name for p in hb.iterdir())
+    assert left == ["commit-3.json", "grow-3.json", "join-0009-fresh",
+                    "reform-3-0", "worker-0.hb", "worker-1.hb"]
+
+
+def test_lease_stop_sweeps_stale_peer_leases(tmp_path):
+    """HeartbeatLease.stop() drops not just our own lease but the
+    stale leases of retired/dead members — the long-lived-stream
+    litter fix — while a FRESH peer lease survives."""
+    clock = FakeClock()
+    me = _lease(tmp_path, clock, index=0, members=(0, 1, 2))
+    fresh_peer = _lease(tmp_path, clock, index=1, members=(0, 1, 2))
+    dead_peer = _lease(tmp_path, clock, index=2, members=(0, 1, 2))
+    me.renew()
+    dead_peer.renew()
+    clock.t += 60.0  # peer 2's lease goes stale
+    me.renew()
+    fresh_peer.renew()
+    me.stop()
+    hb = tmp_path / "hb"
+    assert not (hb / "worker-0.hb").exists()   # own lease removed
+    assert (hb / "worker-1.hb").exists()       # fresh peer untouched
+    assert not (hb / "worker-2.hb").exists()   # stale ghost swept
+
+
+def test_grow_context_barrier_check(tmp_path):
+    """The safe-barrier admission check (single-process arm): a fresh
+    ticket against a free slot plans the next generation; at capacity,
+    or with no ticket, the barrier is a no-op."""
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.train import _GrowContext
+    clock = FakeClock()
+    cfg = FmConfig(elastic="grow", heartbeat_seconds=5.0,
+                   worker_hosts=("h0:7000", "h1:7001"))
+    lease = _lease(tmp_path, clock, index=0, members=(0,))
+    lease.renew()
+    ctx = _GrowContext(cfg, lease, members=[0], generation=1)
+    assert ctx.capacity == 2
+    assert ctx.check_barrier() is None  # no ticket waiting
+    # Real-clock ticket: check_barrier evaluates freshness against
+    # wall time (the production path), unlike the fake-clock lease.
+    tk = lv.JoinTicket(str(tmp_path / "hb"), heartbeat_seconds=5.0,
+                       name="join-0001-t", pid=7)
+    tk.renew()
+    plan = ctx.check_barrier()
+    assert plan == {"generation": 2, "incumbents": [0],
+                    "joiners": {"join-0001-t": 1}}
+    # healed to capacity: the same ticket can no longer be planned
+    ctx.adopt([0, 1], 2)
+    assert ctx.check_barrier() is None
+
+
+# --- fmstat: RECOVERED verdict --------------------------------------------
+
+
+def _elastic_event(members, lost=(), joined=(), capacity=None,
+                   generation=1, kind="shrink"):
+    ev = {"event": "health", "status": "elastic_recovered",
+          "kind": kind, "generation": generation,
+          "members": list(members), "lost": list(lost),
+          "joined": list(joined)}
+    if capacity is not None:
+        ev["capacity"] = capacity
+    return ev
+
+
+def test_recovered_verdict_when_grow_heals_full_membership():
+    hv = health_verdict(_summary(health=[
+        _lost_event(1),
+        _elastic_event([0], lost=[1], capacity=2, generation=1),
+        _elastic_event([0, 1], joined=[1], capacity=2, generation=2,
+                       kind="grow")]))
+    assert hv["verdict"] == "RECOVERED (gen 2, 2 workers)"
+    assert "full membership" in hv["detail"]
+    assert "process 1" in hv["detail"]
+
+
+def test_recovered_requires_last_event_at_capacity():
+    """A grow that healed and then ANOTHER kill (kill-grow-kill): the
+    last elastic event is back below capacity — DEGRADED, not a stale
+    RECOVERED."""
+    hv = health_verdict(_summary(health=[
+        _lost_event(1),
+        _elastic_event([0, 1], joined=[1], capacity=2, generation=2,
+                       kind="grow"),
+        _lost_event(0),
+        _elastic_event([1], lost=[0], capacity=2, generation=3)]))
+    assert hv["verdict"].startswith("DEGRADED")
+
+
+def test_degraded_unchanged_without_capacity_field():
+    """Pre-grow streams (no capacity on the event) keep their
+    historical DEGRADED rendering."""
+    hv = health_verdict(_summary(health=[
+        _lost_event(1),
+        {"event": "health", "status": "elastic_recovered",
+         "generation": 1, "members": [0], "lost": [1]}]))
+    assert hv["verdict"] == "DEGRADED (1 worker lost)"
+
+
+def test_recovered_outranked_by_preempted_and_crash():
+    base = [_lost_event(1),
+            _elastic_event([0, 1], joined=[1], capacity=2,
+                           generation=2, kind="grow")]
+    pre = {"event": "health", "status": "preempted", "step": 5,
+           "epoch": 0}
+    assert health_verdict(
+        _summary(health=base + [pre]))["verdict"] == "PREEMPTED"
+    assert health_verdict(_summary(
+        health=base,
+        crashes=[{"event": "crash", "error": "x"}]))["verdict"] == \
+        "CRASHED"
+
+
+def test_worker_table_unflags_rejoined_slot():
+    rows = worker_table(_summary(
+        health=[_lost_event(1),
+                _elastic_event([0, 1], joined=[1], capacity=2,
+                               generation=2, kind="grow")],
+        gauges={0: {"worker/heartbeat_age_seconds": 0.4,
+                    "worker/windows": 12.0, "worker/examples": 100.0},
+                1: {"worker/heartbeat_age_seconds": 0.5,
+                    "worker/windows": 5.0, "worker/examples": 50.0}}))
+    assert len(rows) == 2
+    assert "LOST" not in rows[1]  # the replacement owns slot 1 now
+
+
 # --- config knobs ---------------------------------------------------------
 
 
 def test_config_rejects_bad_elastic_values():
     from fast_tffm_tpu.config import FmConfig
     with pytest.raises(ValueError, match="elastic"):
-        FmConfig(elastic="grow")
+        FmConfig(elastic="expand")
     with pytest.raises(ValueError, match="heartbeat_seconds"):
         FmConfig(elastic="shrink", heartbeat_seconds=0.0)
+    with pytest.raises(ValueError, match="heartbeat_seconds"):
+        FmConfig(elastic="grow", heartbeat_seconds=0.0)
     with pytest.raises(ValueError, match="collective_timeout_seconds"):
         FmConfig(collective_timeout_seconds=-1.0)
     with pytest.raises(ValueError, match="heartbeat_seconds"):
         FmConfig(heartbeat_seconds=-0.5)
+    with pytest.raises(ValueError, match="join_settle_seconds"):
+        FmConfig(join_settle_seconds=0.0)
+    with pytest.raises(ValueError, match="join_timeout_seconds"):
+        FmConfig(join_timeout_seconds=-1.0)
     cfg = FmConfig(elastic="shrink", heartbeat_seconds=2.0,
                    collective_timeout_seconds=0.0)
     assert cfg.elastic == "shrink"
+    cfg = FmConfig(elastic="grow", heartbeat_seconds=2.0)
+    assert cfg.elastic == "grow"
+    # Streaming grow needs a publish cadence: the publish settle is
+    # the stream's only safe barrier, so a never-publishing stream
+    # could never admit a joiner — a config trap, caught here.
+    with pytest.raises(ValueError, match="publish_interval_seconds"):
+        FmConfig(elastic="grow", run_mode="stream", stream_dir="/tmp/s",
+                 publish_interval_seconds=0.0)
 
 
 def test_cluster_cfg_keys_parse(tmp_path):

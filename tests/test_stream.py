@@ -731,6 +731,31 @@ def test_merge_watermark_payloads_owner_wins_over_stale_chief():
     assert merged["files"][1]["bytes"] == 60
 
 
+def test_merge_watermark_ownership_reagrees_on_membership_change():
+    """Elastic membership changes re-agree ledger ownership simply by
+    merging under the NEW num_shards: every member's tracker was
+    rebuilt from the same restored merged payload, so the entries a
+    worker does not own hold the restored positions — merging with the
+    grown membership picks each entry from whoever advances it NOW,
+    and a fresh joiner's still-empty payload can never drop restored
+    positions (the any-payload fallback has them)."""
+    # Restored state after a 1-worker (shrunken) phase: f0/f1 fully
+    # consumed, carried identically by the survivor.
+    consumed = [_rec("f0", 100), _rec("f1", 100)]
+    # Grown back to 2 workers: survivor (shard 0) advanced f2; the
+    # joiner (shard 1) has stepped nothing yet — short payload.
+    w0 = {"format": 1, "files": consumed + [_rec("f2", 40)]}
+    w1 = {"format": 1, "files": []}
+    merged = sl.merge_watermark_payloads([w0, w1], num_shards=2)
+    assert [f["path"] for f in merged["files"]] == ["f0", "f1", "f2"]
+    assert [f["bytes"] for f in merged["files"]] == [100, 100, 40]
+    # Once the joiner adopts a tag for its owned f3, IT wins entry 3.
+    w1 = {"format": 1,
+          "files": consumed + [_rec("f2", 0), _rec("f3", 60)]}
+    merged = sl.merge_watermark_payloads([w0, w1], num_shards=2)
+    assert [f["bytes"] for f in merged["files"]] == [100, 100, 40, 60]
+
+
 def test_generic_batch_spanning_files_records_both_positions(tmp_path):
     """A tolerant-path batch spanning a file boundary must advance
     EVERY file it touched in the watermark — not just the last one —
