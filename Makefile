@@ -12,7 +12,10 @@
 #                   scorer trials + its host_threads 1/2/4 sweep
 #   make bench-vocab    admission-path overhead: train e2e at
 #                   vocab_mode=admit vs fixed (target <= 5% cost)
-#   make lint       fmlint whole-program pass (R000-R012) over
+#   make bench-wire standalone wire-format sweep: padded-wide vs
+#                   packed-wide vs packed-narrow on h2d_only and e2e,
+#                   with bytes/example on the wire
+#   make lint       fmlint whole-program pass (R000-R013) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
 #   make stream-soak  the streaming run-mode scenarios standalone
@@ -60,6 +63,9 @@ bench-predict: $(SO)
 bench-vocab: $(SO)
 	python bench.py --vocab
 
+bench-wire: $(SO)
+	python bench.py --wire
+
 lint:
 	python -m tools.fmlint
 
@@ -87,4 +93,4 @@ bench-multihost: $(SO)
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict bench-vocab bench-multihost lint chaos stream-soak serve serve-soak slo-soak grow-soak clean
+.PHONY: all test bench bench-host bench-predict bench-vocab bench-wire bench-multihost lint chaos stream-soak serve serve-soak slo-soak grow-soak clean

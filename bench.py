@@ -131,21 +131,46 @@ def _raw_mode(cfg):
     return ModelSpec.from_config(cfg).dedup == "device"
 
 
+def _wire_dispatch(cfg, step):
+    """The bench's train-step dispatch, routed through the wire layer
+    exactly as train() routes it (README "Wire format"): encode ->
+    explicit async device_put (the depth-2 double buffer) -> padded or
+    packed jitted step. One body for run_e2e and the --wire sweep so
+    the measured loop cannot drift from the production dispatch."""
+    import jax
+    from fast_tffm_tpu.models.fm import ModelSpec, make_packed_train_step
+    from fast_tffm_tpu.wire import WireEncoder, resolve_wire
+    wire = resolve_wire(cfg, train=True)
+    enc = WireEncoder(wire, pad_id=cfg.pad_id)
+    if wire.packed:
+        pstep = make_packed_train_step(ModelSpec.from_config(cfg))
+
+        def dispatch(table, acc, batch):
+            wb = enc.encode_train(batch)
+            return pstep(wb.L, table, acc, **jax.device_put(wb.args))
+    else:
+        def dispatch(table, acc, batch):
+            wb = enc.encode_train(batch)
+            return step(table, acc, **jax.device_put(wb.args))
+    return dispatch
+
+
 def run_e2e(cfg, step, n_warm=N_WARM, vocab=None):
-    """One honest end-to-end trial: file -> C++ parse -> build -> H2D ->
-    jitted step, host pipeline prefetching ahead of the device (the same
-    loop train() runs; dedup runs host- or device-side per the resolved
-    spec, like train() does). One timing protocol for every e2e line
-    (FM headline and FFM). ``vocab`` (the --vocab line): the admission
-    runtime, exercised exactly as train() does — remap in the pipeline,
-    note_trained per stepped batch."""
+    """One honest end-to-end trial: file -> C++ parse -> build -> wire
+    encode -> H2D -> jitted step, host pipeline prefetching ahead of
+    the device (the same loop train() runs; dedup runs host- or
+    device-side per the resolved spec, and the dispatch routes through
+    the wire layer, like train() does). One timing protocol for every
+    e2e line (FM headline and FFM). ``vocab`` (the --vocab line): the
+    admission runtime, exercised exactly as train() does — remap in
+    the pipeline, note_trained per stepped batch."""
     import jax
     from fast_tffm_tpu.data.pipeline import (batch_iterator,
                                              gil_bound_iteration, prefetch)
-    from fast_tffm_tpu.models.fm import (batch_args, init_accumulator,
-                                         init_table)
+    from fast_tffm_tpu.models.fm import init_accumulator, init_table
     table = init_table(cfg, 0)
     acc = init_accumulator(cfg)
+    dispatch = _wire_dispatch(cfg, step)
     it = prefetch(batch_iterator(cfg, cfg.train_files, training=True,
                                  raw_ids=_raw_mode(cfg), vocab=vocab),
                   depth=4, gil_bound=gil_bound_iteration(cfg))
@@ -154,7 +179,7 @@ def run_e2e(cfg, step, n_warm=N_WARM, vocab=None):
     n_real = 0  # real examples in the timed span (short final batch counts
     # its actual rows, not batch_size)
     for batch in it:
-        table, acc, loss, _ = step(table, acc, **batch_args(batch))
+        table, acc, loss, _ = dispatch(table, acc, batch)
         if vocab is not None:
             vocab.note_trained(batch)
         n += 1
@@ -289,20 +314,86 @@ def run_k16(cfg16):
 
 
 def run_h2d_only(cfg):
-    """Transfer-only rate: device_put one batch's host arrays per step
-    (the per-step H2D traffic — ~3 MB at L=48 in raw-ids mode, which
-    drops the uniq_ids array), nothing else."""
+    """Transfer-only rate: device_put one batch's WIRE payload per step
+    (the per-step H2D traffic the resolved wire format actually ships —
+    padded rectangles by default, flat CSR under wire_format = packed),
+    nothing else. Also returns the payload bytes so the --wire sweep
+    can report bytes/example beside the rate."""
     import jax
     from fast_tffm_tpu.data.pipeline import batch_iterator
-    from fast_tffm_tpu.models.fm import batch_args
+    from fast_tffm_tpu.wire import WireEncoder, resolve_wire
     batch = next(batch_iterator(cfg, cfg.train_files, training=True,
                                 raw_ids=_raw_mode(cfg)))
-    payload = [v for v in batch_args(batch).values() if v is not None]
+    enc = WireEncoder(resolve_wire(cfg, train=True), pad_id=cfg.pad_id)
+    wb = enc.encode_train(batch)
+    payload = [v for v in wb.args.values() if v is not None]
     jax.block_until_ready(jax.device_put(payload))
     t0 = time.perf_counter()
     for _ in range(N_TIMED):
         jax.block_until_ready(jax.device_put(payload))
-    return N_TIMED * B / (time.perf_counter() - t0)
+    rate = N_TIMED * B / (time.perf_counter() - t0)
+    return rate, wb.wire_bytes, wb.logical_bytes
+
+
+# The --wire sweep's three variants (README "Wire format"): the
+# bit-identical legacy layout, the packed CSR wire, and packed with
+# f16 values/weights.
+WIRE_VARIANTS = (("padded-wide", "padded", "wide"),
+                 ("packed-wide", "packed", "wide"),
+                 ("packed-narrow", "packed", "narrow"))
+
+
+def run_wire_sweep(path):
+    """The wire-format trio on the headline corpus shape: ``h2d_only``
+    (device_put rate of the variant's actual payload) and ``e2e`` (the
+    full loop through the variant's dispatch) for padded-wide vs
+    packed-wide vs packed-narrow, plus bytes/example on the wire — the
+    ISSUE 15 acceptance artifact (`python bench.py --wire` /
+    `make bench-wire`; pinned in the full artifact's "wire" object)."""
+    import dataclasses
+    from fast_tffm_tpu.models.fm import ModelSpec, make_train_step
+    out = {}
+    for name, wf, wd in WIRE_VARIANTS:
+        cfg = dataclasses.replace(make_cfg(path), wire_format=wf,
+                                  wire_dtypes=wd)
+        step = make_train_step(ModelSpec.from_config(cfg))
+        h2d, wire_bytes, logical_bytes = run_h2d_only(cfg)
+        e2e = statistics.median(
+            run_e2e(cfg, step, n_warm=3) for _ in range(TRIALS))
+        out[name] = {
+            "h2d_only": round(h2d, 1),
+            "e2e": round(e2e, 1),
+            "bytes_per_example": round(wire_bytes / B, 1),
+            "logical_bytes_per_example": round(logical_bytes / B, 1),
+        }
+    base = out["padded-wide"]["bytes_per_example"]
+    for name in out:
+        bpe = out[name]["bytes_per_example"]
+        out[name]["bytes_savings_x"] = (round(base / bpe, 2)
+                                        if bpe else None)
+    return out
+
+
+def wire_sweep_main():
+    """Standalone wire-format sweep (`python bench.py --wire` /
+    `make bench-wire`): one JSON line with the padded-wide vs
+    packed-wide vs packed-narrow trio."""
+    import tempfile
+    _enable_compile_cache()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "train.txt")
+        lines = synth_lines((N_WARM + N_TIMED) * B, 1 << 20)
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        del lines
+        res = run_wire_sweep(path)
+    packed = res["packed-wide"]
+    print(json.dumps({
+        "metric": "wire_bytes_savings_x",
+        "value": packed["bytes_savings_x"],
+        "unit": "padded bytes/example over packed (wide)",
+        "wire": res,
+    }))
 
 
 def _enable_compile_cache():
@@ -715,7 +806,7 @@ def main():
                 str(w): run_host_only(_with_workers(cfg, w))
                 for w in HOST_WORKER_SWEEP}
             dev = run_device_only(cfg, step)
-            h2d = run_h2d_only(cfg)
+            h2d, _, _ = run_h2d_only(cfg)
             # Per-worker input rate of the 2-way byte-range sharded
             # fast path (what each process's pipeline sustains in
             # multi-process mode).
@@ -778,6 +869,17 @@ def main():
             print(f"bench quality line failed ({type(e).__name__}: "
                   f"{e}); recording null", file=sys.stderr)
             quality_res = None
+
+        # Wire-format trio (ISSUE 15): padded-wide vs packed-wide vs
+        # packed-narrow on h2d_only and e2e — the ROADMAP item 2
+        # bytes-per-example lever, pinned beside the ceilings it moves.
+        try:
+            wire_res = run_wire_sweep(path)
+        except Exception as e:  # noqa: BLE001 - artifact survival
+            import sys
+            print(f"bench wire sweep failed ({type(e).__name__}: {e}); "
+                  f"recording null", file=sys.stderr)
+            wire_res = None
 
     def med(trials):  # None survives a timed-out line (see _isolated_line)
         return round(statistics.median(trials), 1) if trials else None
@@ -858,6 +960,10 @@ def main():
             if quality_res and quality_res[0] else None,
         "quality_eval_sweep_seconds":
             round(quality_res[2], 3) if quality_res else None,
+        # The wire-format trio (README "Wire format"): per-variant
+        # h2d_only / e2e / bytes-per-example, with the packed savings
+        # multiple over the padded layout.
+        "wire": wire_res,
         "k16_e2e": med(k16),
         "k16_e2e_trials": [round(v, 1) for v in k16] if k16 else None,
         "l64_e2e": med(l64),
@@ -1130,5 +1236,7 @@ if __name__ == "__main__":
         serve_latency_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--multihost":
         multihost_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--wire":
+        wire_sweep_main()
     else:
         main()

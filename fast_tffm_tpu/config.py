@@ -124,6 +124,26 @@ class FmConfig:
     # jit only). "auto" picks device where it applies. Resolved in
     # ModelSpec.from_config.
     dedup: str = "auto"             # "auto" | "host" | "device"
+    # Wire format (README "Wire format"; fast_tffm_tpu/wire.py): how a
+    # built batch crosses the host->device boundary. "padded" (default)
+    # ships the fixed-shape [B, L] rectangles exactly as today —
+    # bit-identical to every prior release. "packed" ships the CSR
+    # substance instead — flat values + per-example lengths (+ the
+    # dedup'd uniq table) bucketed to a power-of-two flat ladder — and
+    # the jitted step/score programs rebuild the padded rectangles
+    # on-device (models/fm.unpack seam), cutting per-step H2D bytes by
+    # the batch's padding-waste fraction. Single-device jit paths only
+    # (mesh / multi-process lockstep / offload TRAIN assemble padded
+    # global arrays and resolve back to padded with a warning —
+    # wire.resolve_wire is the one resolution point).
+    wire_format: str = "padded"     # "padded" | "packed"
+    # Wire dtypes (requires wire_format = packed): "wide" keeps f32
+    # values/weights on the wire — bit-identical math. "narrow" ships
+    # values and weights as float16 (ids are int32 end-to-end already)
+    # and upcasts to f32 on device before any model math — about half
+    # the value bytes for one rounding step on the inputs (training
+    # tolerances, not bit-parity; labels stay f32).
+    wire_dtypes: str = "wide"       # "wide" | "narrow"
     # Profiling (SURVEY §5 "Tracing": reference has none; we dump a
     # TensorBoard/Perfetto trace of a steady-state step window on demand):
     profile_dir: str = ""           # empty = profiling off
@@ -408,6 +428,18 @@ class FmConfig:
                 "unique pass")
         if self.lookup not in ("device", "host"):
             raise ValueError(f"unknown lookup {self.lookup!r}")
+        if self.wire_format not in ("padded", "packed"):
+            raise ValueError(f"unknown wire_format {self.wire_format!r} "
+                             "(want padded | packed)")
+        if self.wire_dtypes not in ("wide", "narrow"):
+            raise ValueError(f"unknown wire_dtypes {self.wire_dtypes!r} "
+                             "(want wide | narrow)")
+        if self.wire_dtypes == "narrow" and self.wire_format != "packed":
+            raise ValueError(
+                "wire_dtypes = narrow requires wire_format = packed: "
+                "the padded rectangles are the bit-identical legacy "
+                "layout — narrowing them silently would betray the "
+                "wide-default parity contract")
         if self.factor_num <= 0:
             raise ValueError("factor_num must be positive")
         if self.vocabulary_size <= 0:
@@ -754,6 +786,8 @@ _TRAIN_KEYS = {
     "uniq_bucket": int,
     "kernel": str,
     "dedup": str,  # accepted in [General] too (model-level knob)
+    "wire_format": str,
+    "wire_dtypes": str,
     "profile_dir": str,
     "profile_start_step": int,
     "profile_num_steps": int,

@@ -64,6 +64,17 @@ adapter maps ``gather``/``apply_grad`` onto its embedding-table
 lookup/update primitives and keeps ``state()`` as the HBM/host fetch of
 its shards — the train loop, checkpointing, and predict then work
 unchanged, exactly as they do for the two backends here.
+
+**Wire format (README "Wire format").** The offload SCORE path rides
+``wire_format = packed``: the encoder withholds ``uniq_ids`` for the
+host-side ``gather`` (``WireBatch.host_uniq``) and only the gathered
+``[U, D]`` rows plus the flat CSR cross the wall — the rectangles are
+rebuilt on-device inside ``models/fm.packed_rows_score_body``, whose
+pad slot is the gathered block's last row (the same contract
+``rows_score_body`` inherits from the padded wire). The offload TRAIN
+step stays on the padded wire (``wire.resolve_wire`` downgrades with a
+warning): its host gather and host scatter consume the numpy batch
+arrays directly, so there is no device-side unpack to fold them into.
 """
 
 from __future__ import annotations

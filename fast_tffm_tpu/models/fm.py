@@ -295,6 +295,95 @@ def make_train_step(spec: ModelSpec):
                    donate_argnums=(0, 1))
 
 
+def _unpack_wire(spec: ModelSpec, L: int, uniq_ids, lengths, flat_idx,
+                 flat_vals, flat_fields=None):
+    """Device-side wire unpack shared by the packed step/score bodies:
+    rebuild the [B, L] rectangles (wire.unpack_rectangles) with the
+    padding sentinel this batch shape uses — the uniq table's last slot
+    in host-dedup mode, the model pad id (vocabulary_size) in raw-ids
+    mode. Narrow-mode f16 values upcast to f32 here, BEFORE any model
+    math."""
+    from fast_tffm_tpu.wire import unpack_rectangles
+    pad = (spec.vocabulary_size if uniq_ids is None
+           else uniq_ids.shape[0] - 1)
+    return unpack_rectangles(L, pad, lengths, flat_idx, flat_vals,
+                             flat_fields)
+
+
+def packed_train_step_body(spec: ModelSpec, L: int, table, acc, labels,
+                           weights, uniq_ids, lengths, flat_idx,
+                           flat_vals, flat_fields=None, *, mesh=None):
+    """One training step from the PACKED wire format (wire.py): unpack
+    the flat CSR back into the padded rectangles on-device, then run
+    the exact train_step_body — same compute graph, ~padding-waste
+    fewer bytes across the wall. ``L`` is static (one executable per
+    (spec, B, L, flat rung, U))."""
+    local_idx, vals, fields = _unpack_wire(spec, L, uniq_ids, lengths,
+                                           flat_idx, flat_vals,
+                                           flat_fields)
+    labels = labels.astype(jnp.float32)
+    weights = weights.astype(jnp.float32)
+    return train_step_body(spec, table, acc, labels, weights, uniq_ids,
+                           local_idx, vals, fields, mesh=mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def make_packed_train_step(spec: ModelSpec):
+    """Jitted packed train step. Signature:
+    (L, table, acc, labels, weights, uniq_ids, lengths, flat_idx,
+     flat_vals[, flat_fields]) -> (table, acc, loss, scores)
+    ``L`` static, table/acc donated (call them positionally)."""
+    return jax.jit(functools.partial(packed_train_step_body, spec),
+                   static_argnums=(0,), donate_argnums=(1, 2))
+
+
+def packed_score_body(spec: ModelSpec, L: int, table, uniq_ids, lengths,
+                      flat_idx, flat_vals, flat_fields=None, *,
+                      mesh=None):
+    """Inference forward from the packed wire format: unpack, then the
+    exact score_body dispatch (raw gather for dedup=device, uniq gather
+    otherwise) — BIT-identical scores to the padded wire in wide
+    mode."""
+    local_idx, vals, fields = _unpack_wire(spec, L, uniq_ids, lengths,
+                                           flat_idx, flat_vals,
+                                           flat_fields)
+    return score_body(spec, table, uniq_ids, local_idx, vals, fields,
+                      mesh=mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def make_packed_score_fn(spec: ModelSpec):
+    """Jitted packed inference: (L, table, uniq_ids, lengths, flat_idx,
+    flat_vals[, flat_fields]) -> raw scores [B]. ``L`` static."""
+    return jax.jit(functools.partial(packed_score_body, spec),
+                   static_argnums=(0,))
+
+
+def packed_rows_score_body(spec: ModelSpec, L: int, gathered, lengths,
+                           flat_idx, flat_vals, flat_fields=None, *,
+                           mesh=None):
+    """Offload-score half of the packed wire (lookup.py's seam): the
+    backend gathered ``[U, D]`` rows on the HOST from the withheld
+    uniq_ids (WireBatch.host_uniq); only those rows plus the flat CSR
+    cross the wall. Padding indexes the gathered block's last row —
+    the same pad-slot contract rows_score_body inherits from the
+    padded wire."""
+    from fast_tffm_tpu.wire import unpack_rectangles
+    local_idx, vals, fields = unpack_rectangles(
+        L, gathered.shape[0] - 1, lengths, flat_idx, flat_vals,
+        flat_fields)
+    return rows_score_body(spec, gathered, local_idx, vals, fields,
+                           mesh=mesh)
+
+
+@functools.lru_cache(maxsize=None)
+def make_packed_rows_score_fn(spec: ModelSpec):
+    """Jitted packed offload inference: (L, gathered, lengths, flat_idx,
+    flat_vals[, flat_fields]) -> raw scores [B]. ``L`` static."""
+    return jax.jit(functools.partial(packed_rows_score_body, spec),
+                   static_argnums=(0,))
+
+
 def rows_score_body(spec: ModelSpec, gathered, local_idx, vals,
                     fields=None, *, mesh=None):
     """Inference forward from already-gathered rows — the score-side half

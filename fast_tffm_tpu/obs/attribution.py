@@ -123,6 +123,19 @@ def _frac(num: Optional[float], den: Optional[float]) -> Optional[float]:
     return num / den
 
 
+def wire_mode(gauges: Dict[str, Any]) -> Optional[str]:
+    """The active wire format + dtype mode (README "Wire format") from
+    the stream's ``wire/*`` gauges — ``"packed-narrow"`` etc. None on a
+    pre-wire stream (no gauge): the mode is then unknown, not assumed
+    padded, so old files never claim a mode they never stamped."""
+    p = gauges.get("wire/packed")
+    if p is None:
+        return None
+    fmt = "packed" if p else "padded"
+    dt = "narrow" if gauges.get("wire/narrow") else "wide"
+    return f"{fmt}-{dt}"
+
+
 def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
     """The host/device/transfer split + verdict for one summary.
 
@@ -144,6 +157,11 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
               + c.get("train/summary_pause_seconds", 0.0)
               + c.get("train/validation_seconds", 0.0))
     h2d_bytes = c.get("train/h2d_bytes", 0.0)
+    # The wire-format pair (README "Wire format"): actual bytes
+    # dispatched vs the padded layout's logical size — the
+    # packed-vs-padded savings ratio, observable per run. Old streams
+    # (pre-wire) carry no logical counter; treat it as equal.
+    h2d_logical = c.get("train/h2d_bytes_logical", h2d_bytes)
 
     out: Dict[str, Any] = {
         "examples": examples,
@@ -157,6 +175,14 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
         "pause_seconds": pauses,
         "pause_fraction": _frac(pauses, loop_s + pauses),
         "h2d_bytes_per_sec": _frac(h2d_bytes, loop_s),
+        # Bytes-per-example on the wire: the lever the packed format
+        # pulls (ROADMAP item 2) — actual dispatched bytes, the padded
+        # layout's logical bytes, and their ratio (>= 2x at the
+        # default config is the packed acceptance bar).
+        "h2d_bytes_per_example": _frac(h2d_bytes, examples),
+        "h2d_logical_bytes_per_example": _frac(h2d_logical, examples),
+        "wire_savings_ratio": _frac(h2d_logical, h2d_bytes),
+        "wire_format": wire_mode(g),
         # Parallel host data plane (README "Data plane"): configured
         # build workers, their summed build seconds over the
         # consumer-observed build+wait time (values near the worker
@@ -316,10 +342,16 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
         out["verdict"] = (f"pause-bound: {pf:.0%} of run time in "
                           "checkpoint/summary/validation pauses")
     else:
+        # Name the active wire format + dtype mode in the
+        # transfer-bound verdict: the first question at this verdict
+        # is "how many bytes per example is the wire shipping, and is
+        # the packed format on" (README "Wire format").
+        wm = out.get("wire_format")
+        wtag = f", wire {wm}" if wm else ""
         out["verdict"] = ("device/transfer-bound: the loop keeps the "
                           "dispatch stream full (host wait "
-                          f"{iw:.0%})" if iw is not None else
-                          "device/transfer-bound")
+                          f"{iw:.0%}{wtag})" if iw is not None else
+                          f"device/transfer-bound{wtag}")
     return out
 
 
@@ -759,6 +791,12 @@ def render(summary: Dict[str, Any]) -> str:
         ("input-wait fraction", att["input_wait_fraction"]),
         ("pause seconds (ckpt/summary/val)", att["pause_seconds"]),
         ("h2d bytes/sec", att["h2d_bytes_per_sec"]),
+        ("h2d bytes/example (wire / padded)",
+         f"{_fmt(att['h2d_bytes_per_example'])} / "
+         f"{_fmt(att['h2d_logical_bytes_per_example'])}"),
+        ("wire format (packed savings x)",
+         f"{att['wire_format'] or '?'} "
+         f"({_fmt(att['wire_savings_ratio'])})"),
         ("host threads / build concurrency",
          f"{_fmt(att['host_threads'])} / "
          f"{_fmt(att['host_build_concurrency'])}"),

@@ -276,14 +276,26 @@ class RunTelemetry:
             self.observe("pipeline/batch_build_seconds", build_seconds)
 
     def train_step(self, dt: float, n_examples: int,
-                   h2d_bytes: int) -> None:
+                   h2d_bytes: int,
+                   h2d_bytes_logical: Optional[int] = None) -> None:
         """Per-train-step host-side points: wall time between step
         dispatches (NOT a device sync — the honest measurable without a
-        fetch), examples, H2D payload bytes."""
+        fetch), examples, H2D payload bytes.
+
+        ``h2d_bytes`` sizes the arrays ACTUALLY dispatched (the wire
+        encoder's output — under wire_format = packed that is the flat
+        CSR payload, not the padded rectangles); ``h2d_bytes_logical``
+        sizes the padded layout the legacy wire would have shipped, so
+        the packed-vs-padded savings ratio is observable per run
+        (fmstat's bytes-per-example row). Omitted = same as actual
+        (the padded wire)."""
         self.observe("train/step_seconds", dt)
         self.count("train/steps")
         self.count("train/examples", n_examples)
         self.count("train/h2d_bytes", h2d_bytes)
+        self.count("train/h2d_bytes_logical",
+                   h2d_bytes if h2d_bytes_logical is None
+                   else h2d_bytes_logical)
 
 
 def resolve_metrics_path(cfg,
@@ -330,8 +342,13 @@ def make_telemetry(cfg, kind: str,
 
 
 def batch_payload_bytes(args: Dict[str, Any]) -> int:
-    """Host-side H2D payload estimate for one batch's arg dict (the
-    arrays about to be shipped); no device interaction."""
+    """Host-side H2D payload size for one batch's arg dict — the
+    arrays ACTUALLY about to be dispatched, so callers must pass the
+    wire encoder's output, not the padded batch layout (under
+    wire_format = packed the two differ by the padding-waste factor,
+    and sizing the padded dict here is exactly how train/h2d_bytes and
+    fmstat's transfer-bound attribution would silently lie). No device
+    interaction."""
     n = 0
     for v in args.values():
         nb = getattr(v, "nbytes", None)

@@ -68,11 +68,12 @@ class CompiledScorer:
     handle is cheap to construct and compiled code outlives it."""
 
     def __init__(self, cfg: FmConfig, mesh=None, backend=None,
-                 dedup: Optional[str] = None):
+                 dedup: Optional[str] = None, serve_ladder: bool = False):
         import dataclasses
         from fast_tffm_tpu.models.fm import (ModelSpec,
                                              make_batch_scorer,
                                              ships_raw_batches)
+        from fast_tffm_tpu.wire import WireEncoder, resolve_wire
         spec = ModelSpec.from_config(cfg)
         if dedup is not None:
             spec = dataclasses.replace(spec, dedup=dedup)
@@ -84,6 +85,28 @@ class CompiledScorer:
         # make_device_batch by every caller.
         self.raw = ships_raw_batches(spec, mesh=mesh, backend=backend)
         self._score = make_batch_scorer(spec, mesh=mesh, backend=backend)
+        # Wire format (README "Wire format"; wire.py): the one encoder
+        # every inference surface dispatches through. Packed mode ships
+        # flat CSR and the jitted program rebuilds the rectangles
+        # on-device; the offload path withholds uniq_ids for its host
+        # gather and ships only the gathered rows + flat CSR.
+        self.wire = resolve_wire(cfg, mesh=mesh, backend=backend)
+        # ``serve_ladder``: the server's encoder buckets flat arrays to
+        # the coarse rect-fraction ladder so its pre-compiled shape
+        # matrix stays bounded (wire.rect_fraction_rungs).
+        self.encoder = WireEncoder(self.wire, pad_id=cfg.pad_id,
+                                   host_uniq=backend is not None,
+                                   rect_fraction=serve_ladder)
+        # Explicit async device_put (the depth-2 double buffer) applies
+        # on the plain single-device path only — mesh placement and the
+        # offload host gather have their own protocols.
+        self._stage = mesh is None and backend is None
+        if self.wire.packed:
+            from fast_tffm_tpu.models.fm import (make_packed_rows_score_fn,
+                                                 make_packed_score_fn)
+            self._packed_fn = (make_packed_rows_score_fn(spec)
+                               if backend is not None
+                               else make_packed_score_fn(spec))
 
     def score_batch(self, table, batch) -> "object":
         """Raw [B] scores (device-resident) for one DeviceBatch —
@@ -91,10 +114,36 @@ class CompiledScorer:
         them. Deliberately does not materialize to numpy (see
         make_batch_scorer: a per-batch fetch collapses async
         dispatch)."""
-        from fast_tffm_tpu.models.fm import batch_args
-        args = batch_args(batch)
-        args.pop("labels"), args.pop("weights")
+        wb = self.encoder.encode_score(batch)
+        if wb.packed:
+            if self.backend is not None:
+                gathered = self.backend.gather(wb.host_uniq)
+                return self._packed_fn(wb.L, gathered, **wb.args)
+            args = self.encoder.device_put(wb)
+            return self._packed_fn(wb.L, table, **args)
+        args = (self.encoder.device_put(wb) if self._stage
+                else dict(wb.args))
         return self._score(table, args)
+
+    def score_packed_shape(self, table, B: int, L: int, P: int):
+        """Dispatch an all-padding synthetic batch at one
+        (B, L, flat-rung) shape — the serving warmup walks every rung a
+        flush could encode to, so packed mode keeps the no-recompile
+        guarantee (serve/server._warmup). Raw-ids (dedup=device)
+        scorers only — exactly the shape the server forces."""
+        if not self.wire.packed or not self.raw:
+            raise ValueError("score_packed_shape warms the packed "
+                             "raw-ids scorer only")
+        from fast_tffm_tpu.wire import NARROW_VALUE_DTYPE
+        vdt = (NARROW_VALUE_DTYPE if self.wire.narrow else np.float32)
+        args = {"uniq_ids": None,
+                "lengths": np.zeros(B, dtype=np.int32),
+                "flat_idx": np.full(P, self.spec.vocabulary_size,
+                                    dtype=np.int32),
+                "flat_vals": np.zeros(P, dtype=vdt)}
+        if self.spec.model_type == "ffm":
+            args["flat_fields"] = np.zeros(P, dtype=np.int32)
+        return self._packed_fn(L, table, **args)
 
 
 class ScoreWriter:
@@ -295,6 +344,11 @@ def score_sweep(cfg: FmConfig, table, files: Sequence[str],
     fetcher = ChunkedFetcher(
         lambda s, num_real: demux.consume(s[:num_real]), overlap=True)
     tel = active()
+    if tel is not None:
+        # The active wire mode, as gauges — fmstat's transfer-bound
+        # attribution names it (README "Wire format").
+        tel.set("wire/packed", 1.0 if scorer.wire.packed else 0.0)
+        tel.set("wire/narrow", 1.0 if scorer.wire.narrow else 0.0)
     n_examples = 0
     # try/finally (ADVICE round 5): an exception mid-sweep must not
     # leave the overlap worker parked on queue.get forever with a

@@ -194,7 +194,15 @@ class ScorerServer:
         from fast_tffm_tpu.obs.slo import SloSpec
         SloSpec.from_config(cfg).emit_gauges(self._reg)
         from fast_tffm_tpu.scoring import CompiledScorer
-        self._scorer = CompiledScorer(cfg, dedup="device")
+        self._scorer = CompiledScorer(cfg, dedup="device",
+                                      serve_ladder=True)
+        # The active wire mode, as gauges (README "Wire format"): the
+        # serving flush inherits the packed path through the scorer's
+        # encoder, and fmstat's attribution names the mode.
+        self._reg.set("wire/packed",
+                      1.0 if self._scorer.wire.packed else 0.0)
+        self._reg.set("wire/narrow",
+                      1.0 if self._scorer.wire.narrow else 0.0)
         # Unbounded vocabulary (vocab_mode = admit; README "Unbounded
         # vocabulary"): requests parse into the hashed id space and
         # every flush remaps through the slot map loaded WITH the
@@ -265,10 +273,11 @@ class ScorerServer:
                 self, poll_seconds=cfg.serve_poll_seconds).start()
         self._logger.info(
             "serving checkpoint step %d from %s (%d batch x %d width "
-            "rungs pre-compiled, max_batch=%d, max_wait=%.1fms)",
+            "rungs pre-compiled, max_batch=%d, max_wait=%.1fms, "
+            "wire=%s)",
             self._served_step, self.directory, len(self._b_ladder),
             len(self._l_rungs), cfg.serve_max_batch,
-            cfg.serve_max_wait_ms)
+            cfg.serve_max_wait_ms, self._scorer.wire.describe())
 
     # -- model load / hot reload ----------------------------------------
 
@@ -537,6 +546,17 @@ class ScorerServer:
                         batch = self._vocab_map.remap(batch)
                     jax.device_get(
                         self._scorer.score_batch(self._table, batch))
+                    if self._scorer.wire.packed:
+                        # Packed wire (README "Wire format"): a flush
+                        # encodes to ANY flat rung up to B*L, so the
+                        # no-recompile guarantee must cover every rung,
+                        # not just the one the synthetic batch above
+                        # happened to hit.
+                        from fast_tffm_tpu.wire import flat_rungs
+                        for P in flat_rungs(B, L):
+                            jax.device_get(
+                                self._scorer.score_packed_shape(
+                                    self._table, B, L, P))
         self.compiled_shapes = tuple(
             (B, L) for B in self._b_ladder for L in self._l_rungs)
         self._reg.set("serve/compiled_shapes",

@@ -34,9 +34,8 @@ from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
                                      make_train_step, ships_raw_batches)
-from fast_tffm_tpu.obs.telemetry import (active, batch_payload_bytes,
-                                         make_telemetry, pop_active,
-                                         push_active)
+from fast_tffm_tpu.obs.telemetry import (active, make_telemetry,
+                                         pop_active, push_active)
 from fast_tffm_tpu.obs.trace import span
 from fast_tffm_tpu.utils.fetch import ChunkedFetcher, bulk_fetch
 from fast_tffm_tpu.utils.logging import get_logger
@@ -787,6 +786,76 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 acc = init_accumulator(cfg)
             step_fn = make_train_step(spec)
 
+        # Wire format (README "Wire format"; wire.py): resolve the
+        # knobs for THIS dispatch path, build the one encoder every
+        # step ships through, and pre-build the packed step when
+        # active. Staging (the explicit async device_put double
+        # buffer) applies on the plain single-device jit path only —
+        # mesh/lockstep placement and the offload host gather have
+        # their own protocols.
+        from fast_tffm_tpu.wire import WireEncoder, resolve_wire
+        wire_spec = resolve_wire(cfg, mesh=mesh, backend=lk,
+                                 multi_process=multi_process, train=True)
+        wire_enc = WireEncoder(wire_spec, pad_id=cfg.pad_id)
+        wire_stage = (not multi_process and mesh is None and not offload)
+        packed_step = None
+        if wire_spec.packed:
+            from fast_tffm_tpu.models.fm import make_packed_train_step
+            packed_step = make_packed_train_step(spec)
+            logger.info(
+                "wire format: %s (flat CSR + on-device unpack, "
+                "double-buffered H2D)", wire_spec.describe())
+        if tel is not None:
+            # The active wire mode, as gauges — fmstat's transfer-bound
+            # attribution names it beside the bytes-per-example row.
+            tel.set("wire/packed", 1.0 if wire_spec.packed else 0.0)
+            tel.set("wire/narrow", 1.0 if wire_spec.narrow else 0.0)
+
+        def _wire_place(batch):
+            """Encode one batch and place its arrays for dispatch —
+            the ONE body both run-mode loops share (a drifted copy
+            here is how the two modes' h2d accounting or placement
+            would silently diverge). h2d_bytes = wb.wire_bytes sizes
+            the arrays ACTUALLY shipped; the padded-layout size rides
+            on wb.logical_bytes for the savings counter."""
+            wb = wire_enc.encode_train(batch)
+            if multi_process:
+                # The global-array assembly ships every shard's bytes.
+                with span("train/h2d", bytes=wb.wire_bytes):
+                    args = global_batch(mesh, len(batch.uniq_ids),
+                                        **wb.args)
+            elif mesh is not None:
+                with span("train/h2d", bytes=wb.wire_bytes):
+                    args = shard_batch(mesh, **wb.args)
+            elif wire_stage:
+                # Depth-2 double buffer: the explicit async put rides
+                # the copy stream while the PREVIOUS step is still
+                # executing, instead of serializing at the head of
+                # this step's dispatch.
+                with span("train/h2d", bytes=wb.wire_bytes):
+                    args = wire_enc.device_put(wb)
+            else:
+                args = wb.args
+            return wb, args
+
+        def _wire_step(wb, args, table, acc):
+            """Dispatch one placed batch through the right compiled
+            step (shared by both loops, like _wire_place)."""
+            if multi_process:
+                # The sharded step IS a collective program: on a dead
+                # cluster its dispatch blocks inside the program's
+                # collectives exactly like a host allgather (pinned by
+                # the hang-worker chaos stack dumps), so it runs under
+                # the same deadline guard.
+                from fast_tffm_tpu.parallel.liveness import (
+                    guarded_collective)
+                return guarded_collective(
+                    step_fn, table, acc,
+                    label="train/step_dispatch", **args)
+            if wb.packed:
+                return packed_step(wb.L, table, acc, **args)
+            return step_fn(table, acc, **args)
+
         def _vocab_reset(rows):
             """The eviction hook: cold-start freed rows through the
             backend's half of the slot seam (lookup.reset_rows for
@@ -1026,6 +1095,8 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             import time as _time
             if cfg.log_steps <= 0:
                 return "deferred"  # mode never consulted without log lines
+            # fmlint: disable=R013 -- a one-scalar link-latency probe,
+            # not a batch: the wire encoder has nothing to encode here
             probe = jax.device_put(np.float32(0.0))
             jax.block_until_ready(probe)
             float(probe)  # throwaway: lazy transfer-path init stays untimed
@@ -1328,26 +1399,11 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # the barrier evicted/reset/reassigned (one int
                     # compare when nothing moved).
                     batch = vocab.ensure_current(batch)
-                args = batch_args(batch)
-                h2d_bytes = (batch_payload_bytes(args)
-                             if tel is not None else 0)
-                if multi_process:
-                    with span("train/h2d", bytes=h2d_bytes):
-                        args = global_batch(mesh, len(batch.uniq_ids),
-                                            **args)
-                elif mesh is not None:
-                    with span("train/h2d", bytes=h2d_bytes):
-                        args = shard_batch(mesh, **args)
+                wb, args = _wire_place(batch)
+                h2d_bytes = wb.wire_bytes
                 with span("train/step", step=global_step + 1):
-                    if multi_process:
-                        from fast_tffm_tpu.parallel.liveness import (
-                            guarded_collective)
-                        table, acc, loss, _ = guarded_collective(
-                            step_fn, table, acc,
-                            label="train/step_dispatch", **args)
-                    else:
-                        table, acc, loss, _ = step_fn(table, acc,
-                                                      **args)
+                    table, acc, loss, _ = _wire_step(wb, args,
+                                                     table, acc)
                 global_step += 1
                 if batch.stream_pos is not None:
                     # The durable position advances ONLY with stepped
@@ -1374,7 +1430,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # step_seconds histogram (always-on aggregate)
                     now = time.perf_counter()
                     tel.train_step(now - t_prev[0], batch.num_real,
-                                   h2d_bytes)
+                                   h2d_bytes, wb.logical_bytes)
                     t_prev[0] = now
                     tel.heartbeat(global_step)
                 profile_tick(global_step)
@@ -1633,20 +1689,8 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # this is the one-integer-compare insurance the
                     # stream loop actually needs (see step_once).
                     batch = vocab.ensure_current(batch)
-                args = batch_args(batch)
-                # H2D payload sized host-side, BEFORE placement turns
-                # the numpy arrays into device arrays.
-                h2d_bytes = (batch_payload_bytes(args)
-                             if tel is not None else 0)
-                if multi_process:
-                    # span (obs/trace): the explicit H2D dispatch — the
-                    # global-array assembly ships every shard's bytes.
-                    with span("train/h2d", bytes=h2d_bytes):
-                        args = global_batch(mesh, len(batch.uniq_ids),
-                                            **args)
-                elif mesh is not None:
-                    with span("train/h2d", bytes=h2d_bytes):
-                        args = shard_batch(mesh, **args)
+                wb, args = _wire_place(batch)
+                h2d_bytes = wb.wire_bytes
                 # trace_span only while a profiler window is open: a
                 # per-step TraceAnnotation costs ~14x throughput on this
                 # platform when nothing is tracing. (Distinct from the
@@ -1656,21 +1700,8 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                             else contextlib.nullcontext())
                 with span("train/step", step=global_step + 1):
                     with prof_ann:
-                        if multi_process:
-                            # The sharded step IS a collective program:
-                            # on a dead cluster its dispatch blocks
-                            # inside the program's collectives exactly
-                            # like a host allgather (pinned by the
-                            # hang-worker chaos stack dumps), so it
-                            # runs under the same deadline guard.
-                            from fast_tffm_tpu.parallel.liveness import (
-                                guarded_collective)
-                            table, acc, loss, _ = guarded_collective(
-                                step_fn, table, acc,
-                                label="train/step_dispatch", **args)
-                        else:
-                            table, acc, loss, _ = step_fn(table, acc,
-                                                          **args)
+                        table, acc, loss, _ = _wire_step(wb, args,
+                                                         table, acc)
                 global_step += 1
                 last_val = None  # table advanced; any cached AUC is stale
                 if vocab is not None:
@@ -1692,7 +1723,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # train/step span is the timeline view)
                     now = time.perf_counter()
                     tel.train_step(now - t_step_prev, batch.num_real,
-                                   h2d_bytes)
+                                   h2d_bytes, wb.logical_bytes)
                     t_step_prev = now
                     # Watchdog progress beat: one tuple assignment
                     # (obs/health.py) — the stall detector's only

