@@ -15,8 +15,11 @@
 #   make bench-wire standalone wire-format sweep: padded-wide vs
 #                   packed-wide vs packed-narrow on h2d_only and e2e,
 #                   with bytes/example on the wire
-#   make lint       fmlint whole-program pass (R000-R013) over
-#                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py
+#   make lint       fmlint whole-program pass (R000-R017) over
+#                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py;
+#                   writes the machine-readable findings artifact to
+#                   .fmlint_cache/findings.json and prints per-rule
+#                   wall time (--profile)
 #   make chaos      fault-injection soak scenarios on CPU (fmchaos)
 #   make stream-soak  the streaming run-mode scenarios standalone
 #                   (torn writes / SIGTERM+resume / truncation)
@@ -67,7 +70,7 @@ bench-wire: $(SO)
 	python bench.py --wire
 
 lint:
-	python -m tools.fmlint
+	python -m tools.fmlint --profile --json-out .fmlint_cache/findings.json
 
 chaos: $(SO)
 	JAX_PLATFORMS=cpu python -m tools.fmchaos

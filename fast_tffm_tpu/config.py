@@ -163,6 +163,12 @@ class FmConfig:
     # — cheap, but a months-long run doesn't want them unrequested.
     # Export the stream with tools/fmtrace for ui.perfetto.dev.
     trace_spans: bool = False
+    # Collective-protocol tracing (parallel/liveness.py; needs
+    # metrics_file). Every guarded collective emits a `collective`
+    # event (sequence number + label); `fmtrace --collectives` diffs
+    # the per-rank streams — the runtime oracle for fmlint R014. Env
+    # fallback: FM_PROTOCOL_TRACE=1.
+    protocol_trace: bool = False
     # Run-health watchdog (obs/health.py; needs metrics_file). > 0:
     # a daemon thread emits a `health: stalled` event and dumps
     # all-thread stacks to <metrics_file>.stacks when no train/predict
@@ -794,6 +800,7 @@ _TRAIN_KEYS = {
     "metrics_file": str,
     "metrics_flush_steps": int,
     "trace_spans": bool,
+    "protocol_trace": bool,
     "watchdog_stall_seconds": float,
     "bad_line_policy": str,
     "max_bad_fraction": float,

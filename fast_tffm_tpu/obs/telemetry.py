@@ -130,6 +130,7 @@ class RunTelemetry:
 
     def __init__(self, path: str, meta: Dict[str, Any],
                  flush_steps: int = 0, trace_spans: bool = False,
+                 protocol_trace: bool = False,
                  watchdog_stall_seconds: float = 0.0):
         self.registry = MetricsRegistry()
         self.sink = JsonlSink(path, meta=meta)
@@ -139,6 +140,9 @@ class RunTelemetry:
         # Span tracing (obs/trace.py): span() reads this flag through
         # active(), so the off cost at every site stays one global read.
         self.trace_spans = bool(trace_spans)
+        # Collective-protocol tracing (parallel/liveness.py):
+        # guarded_collective reads this through active() the same way.
+        self.protocol_trace = bool(protocol_trace)
         # Compute-plane liveness (parallel/liveness.py): the train/
         # predict drivers attach their HeartbeatLease here so every
         # metrics flush carries per-worker liveness gauges (the fmstat
@@ -337,6 +341,7 @@ def make_telemetry(cfg, kind: str,
                             process_count=process_count),
         flush_steps=cfg.metrics_flush_steps,
         trace_spans=getattr(cfg, "trace_spans", False),
+        protocol_trace=getattr(cfg, "protocol_trace", False),
         watchdog_stall_seconds=getattr(cfg, "watchdog_stall_seconds",
                                        0.0))
 

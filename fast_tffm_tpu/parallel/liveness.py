@@ -770,8 +770,71 @@ def current_guard() -> Optional[_GuardState]:
     return _GUARD
 
 
+# --- protocol tracing (the fmlint R014 runtime oracle) ----------------
+
+_PROTOCOL_TRACE: Optional[bool] = None  # enable_protocol_trace override
+_PROTOCOL_ENV: Optional[bool] = None    # cached FM_PROTOCOL_TRACE parse
+_PROTOCOL_SEQ = 0
+# Collectives post from the driver loop only (fmlint R015 proves it),
+# but the trace helpers must stay thread-clean anyway so a caller that
+# ever moves onto a thread trips R015 alone, not a cascade of R008s
+# over this module's state.
+_PROTOCOL_LOCK = threading.Lock()
+
+
+def protocol_trace_enabled() -> bool:
+    """Whether every guarded collective should also emit a
+    ``collective`` telemetry event (sequence number + label + op).
+    Three switches, in precedence order: an explicit
+    ``enable_protocol_trace()`` call, the ``FM_PROTOCOL_TRACE`` env
+    fallback (same-named [Train] knob, fmlint R009), and the active
+    run's ``protocol_trace`` config knob. The per-rank event streams
+    are the ground truth ``fmtrace --collectives`` diffs against the
+    static protocol automaton — identical sequences on every rank, or
+    the first mismatching pair names the deadlock."""
+    if _PROTOCOL_TRACE is not None:
+        return _PROTOCOL_TRACE
+    global _PROTOCOL_ENV
+    if _PROTOCOL_ENV is None:
+        with _PROTOCOL_LOCK:
+            raw = os.environ.get("FM_PROTOCOL_TRACE", "")
+            _PROTOCOL_ENV = raw.strip().lower() not in ("", "0", "false",
+                                                        "no")
+    if _PROTOCOL_ENV:
+        return True
+    from fast_tffm_tpu.obs.telemetry import active
+    tel = active()
+    return tel is not None and getattr(tel, "protocol_trace", False)
+
+
+def enable_protocol_trace(on: bool = True) -> None:
+    global _PROTOCOL_TRACE
+    _PROTOCOL_TRACE = bool(on)
+
+
+def _trace_protocol_op(label: str, fn: Callable) -> None:
+    """Emit one ``collective`` event BEFORE the op posts, so a hung
+    collective still shows the attempted label as the stream's last
+    entry. Tracing must never kill a run — a sink failure is
+    swallowed."""
+    global _PROTOCOL_SEQ
+    try:
+        from fast_tffm_tpu.obs.telemetry import active
+        tel = active()
+        if tel is None:
+            return
+        with _PROTOCOL_LOCK:
+            _PROTOCOL_SEQ += 1
+            seq = _PROTOCOL_SEQ
+        tel.sink.emit("collective", {
+            "seq": seq, "label": label,
+            "op": getattr(fn, "__name__", type(fn).__name__)})
+    except Exception:
+        pass
+
+
 def guarded_collective(fn: Callable, *args, label: str = "collective",
-                       **kwargs):
+                       collective: bool = True, **kwargs):
     """Run a blocking collective under the process's deadline guard —
     a HOST collective (process_allgather, broadcast, sync) or the
     dispatch/fetch of a collective XLA program (the lockstep step and
@@ -791,6 +854,13 @@ def guarded_collective(fn: Callable, *args, label: str = "collective",
       stale peers is escalated by the monitor thread: diagnosis event,
       stack dump, and a hard exit with ``EXIT_WORKER_LOST``.
     """
+    if collective and protocol_trace_enabled():
+        # collective=False marks a guarded wrap that is NOT a
+        # collective program (the lockstep score fetch is a local D2H
+        # wait that runs a different number of times per rank when a
+        # window drains empty) — tracing it would make every healthy
+        # run look divergent under fmtrace --collectives.
+        _trace_protocol_op(label, fn)
     state = _GUARD
     if state is None:
         return fn(*args, **kwargs)

@@ -382,10 +382,14 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         if not pending:
             return []
         with span("lockstep/score_fetch", batches=len(pending)):
+            # collective=False: this is a LOCAL device wait (it runs
+            # only when this rank's pending window is non-empty, a
+            # per-rank count) — it rides the guard for the deadline,
+            # not the protocol trace.
             return guarded_collective(
                 lambda: [(batch, local_rows(score))
                          for batch, score in pending],
-                label="lockstep/score_fetch")
+                label="lockstep/score_fetch", collective=False)
 
     while True:
         window = []

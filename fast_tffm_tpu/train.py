@@ -405,7 +405,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                 return _train_session(cfg, logger, tel, bad_tracker,
                                       shard_index, num_shards,
                                       grow_ctx=grow_ctx)
-            except ClusterGrowth as g:
+            except ClusterGrowth as g:  # fmlint: disable=R014 -- cluster-wide arm, see below
+                # R014: ClusterGrowth is raised off the chief-broadcast
+                # grow plan at the admission barrier, so every incumbent
+                # takes this arm on the same iteration, and
+                # reform_grown_cluster re-synchronizes the collective
+                # protocol state before the session restarts.
                 # fmlint: disable=R001 -- plan fields are parsed JSON
                 # host values (liveness.plan_grow), never device arrays
                 generation = int(g.plan["generation"])
@@ -459,7 +464,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
                     guard_prev = install_guard(
                         lease, cfg.collective_timeout_seconds)
                     guard_installed = True
-            except WorkerLostError as e:
+            except WorkerLostError as e:  # fmlint: disable=R014 -- survivor-wide arm, see below
+                # R014: every survivor's deadline guard raises off the
+                # same stale lease entry, so the survivors take this arm
+                # together; the non-elastic path re-raises (fail fast)
+                # and the elastic path re-forms the cluster, which
+                # re-synchronizes the protocol state from scratch.
                 if (cfg.elastic not in ("shrink", "grow")
                         or num_shards <= 1 or lease is None):
                     _record_crash(tel, logger, e)
@@ -1565,6 +1575,11 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                             # diverge from; `batch` reads as
                             # rank-tainted only through the tracker's
                             # shard_index plumbing
+                            # fmlint: disable=R014 -- same
+                            # single-process-arm justification: the
+                            # loop's collectives are all gated on
+                            # multi_process, so this escape leaves no
+                            # peer's sequence unmatched
                             if batch is streamlib.DONE:
                                 if preempted:
                                     emit_preempted()
@@ -1681,6 +1696,11 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                                 "step": global_step, "epoch": epoch,
                                 "signals": sigs})
                         break
+                    # fmlint: disable=R014 -- single-process arm (the
+                    # multi_process arm above agrees on exhaustion via
+                    # the train/step_flags allgather before breaking);
+                    # the loop's collectives are gated on multi_process
+                    # so this escape leaves no peer unmatched
                     if batch is None:
                         break
                 if vocab is not None:

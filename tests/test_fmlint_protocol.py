@@ -1,0 +1,575 @@
+"""Protocol model checker (fmlint R014–R017): unit behaviors on
+synthetic projects, plus the four acceptance mutants planted into the
+REAL modules through the ``overlay=`` seam — divergent restore
+collective (R014), thread-reachable collective (R015), serve lock-order
+inversion (R016), and a lock held across a device fetch (R017) — each
+producing exactly one finding naming the offending call/lock pair while
+unmutated HEAD stays clean."""
+
+import os
+import textwrap
+
+from tools.fmlint.core import run_paths
+from tools.fmlint.project import (collective_ops, load_project,
+                                  parse_files, protocol_automaton)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FM = os.path.join(REPO, "fast_tffm_tpu")
+
+
+def _project(tmp_path, files):
+    paths = []
+    for rel, body in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(body))
+        if rel.endswith(".py"):
+            paths.append(str(p))
+    return str(tmp_path), paths
+
+
+def _findings(tmp_path, files, rule=None):
+    root, _ = _project(tmp_path, files)
+    found = run_paths([root])
+    if rule is not None:
+        found = [f for f in found if f.rule == rule]
+    return found
+
+
+# R014 is scoped to the protocol modules; synthetic projects reuse a
+# real suffix so the scope gate admits them.
+_PROTO = "pkg/fast_tffm_tpu/checkpoint.py"
+
+_WALKBACK = """\
+    from jax.experimental import multihost_utils
+
+    def bcast(v):
+        return multihost_utils.broadcast_one_to_all(v)
+
+    class M:
+        def _attempt_restore(self):
+            return 1, None
+
+        def walk(self):
+            restored, err = self._attempt_restore()
+            if err is None:
+                return restored
+            return bcast(0)
+"""
+
+
+def test_r014_branch_on_local_restore_outcome(tmp_path):
+    """The PR 4 walk-back bug class: branching on a per-process
+    restore outcome with a collective in only one continuation."""
+    found = _findings(tmp_path, {_PROTO: _WALKBACK}, rule="R014")
+    assert len(found) == 1, found
+    assert "diverges on per-process data" in found[0].message
+    assert "bcast()" in found[0].message
+
+
+def test_r014_agreed_condition_is_clean(tmp_path):
+    """Same shape, but the condition routes through an allgather
+    (the _all_agree pattern) — uniform, so no finding."""
+    found = _findings(tmp_path, {_PROTO: """\
+        from jax.experimental import multihost_utils
+
+        def bcast(v):
+            return multihost_utils.broadcast_one_to_all(v)
+
+        def agree(flag):
+            return bool(multihost_utils.process_allgather(flag).all())
+
+        class M:
+            def _attempt_restore(self):
+                return 1, None
+
+            def walk(self):
+                restored, err = self._attempt_restore()
+                if agree(err is None):
+                    return restored
+                return bcast(0)
+    """}, rule="R014")
+    assert found == [], found
+
+
+def test_r014_raise_arm_is_sanctioned(tmp_path):
+    """A raise-terminated arm with no collectives is the die-loudly
+    path the liveness guard bounds — exempt by design."""
+    found = _findings(tmp_path, {_PROTO: """\
+        from jax.experimental import multihost_utils
+
+        def bcast(v):
+            return multihost_utils.broadcast_one_to_all(v)
+
+        class M:
+            def _attempt_restore(self):
+                return 1, None
+
+            def walk(self):
+                restored, err = self._attempt_restore()
+                if err is not None:
+                    raise err
+                return bcast(0)
+    """}, rule="R014")
+    assert found == [], found
+
+
+def test_r014_loop_carried_divergence(tmp_path):
+    """A collective inside a loop whose trip count is per-process:
+    the shape R007 (single-branch) cannot see."""
+    found = _findings(tmp_path, {_PROTO: """\
+        from jax.experimental import multihost_utils
+
+        def bcast(v):
+            return multihost_utils.broadcast_one_to_all(v)
+
+        class M:
+            def _attempt_restore(self):
+                return 1, None
+
+            def walk(self):
+                n, _ = self._attempt_restore()
+                while n > 0:
+                    bcast(n)
+                    n -= 1
+    """}, rule="R014")
+    assert len(found) == 1, found
+    assert "different iteration counts" in found[0].message
+
+
+def test_r014_swallowed_exception_arm(tmp_path):
+    """A handler that swallows a failure of a collective-bearing try
+    body leaves this rank's sequence a prefix of its peers'."""
+    src = """\
+        from jax.experimental import multihost_utils
+
+        def bcast(v):
+            return multihost_utils.broadcast_one_to_all(v)
+
+        def step():
+            try:
+                bcast(1)
+            except Exception:
+                {handler}
+    """
+    found = _findings(tmp_path, {_PROTO: src.format(handler="pass")},
+                      rule="R014")
+    assert len(found) == 1, found
+    assert "swallows a failure" in found[0].message
+    # The escalating twin re-raises: the guard converts the death to a
+    # bounded diagnosed exit, so the sequence never silently shortens.
+    found = _findings(tmp_path, {_PROTO: src.format(handler="raise")},
+                      rule="R014")
+    assert found == [], found
+
+
+def test_r015_thread_target_closure(tmp_path):
+    found = _findings(tmp_path, {"m.py": """\
+        import threading
+        from jax.experimental import multihost_utils
+
+        def work():
+            multihost_utils.process_allgather(1)
+
+        def start():
+            threading.Thread(target=work).start()
+    """}, rule="R015")
+    assert len(found) == 1, found
+    assert "process_allgather" in found[0].message
+    assert "thread-reachable" in found[0].message
+
+
+def test_r016_lock_order_cycle_and_consistent_twin(tmp_path):
+    src = """\
+        import threading
+        _lock_a = threading.Lock()
+        _lock_b = threading.Lock()
+
+        def f():
+            with _lock_a:
+                with _lock_b:
+                    pass
+
+        def g():
+            with {first}:
+                with {second}:
+                    pass
+    """
+    found = _findings(
+        tmp_path / "inv",
+        {"m.py": src.format(first="_lock_b", second="_lock_a")},
+        rule="R016")
+    assert len(found) == 1, found
+    assert "m._lock_a" in found[0].message and "m._lock_b" in found[0].message
+    # Consistent global order: no cycle.
+    found = _findings(
+        tmp_path / "ok",
+        {"m.py": src.format(first="_lock_a", second="_lock_b")},
+        rule="R016")
+    assert found == [], found
+
+
+def test_r016_interprocedural_edge(tmp_path):
+    """The second edge of the cycle runs through a call made under a
+    lock into a function that takes the other lock."""
+    found = _findings(tmp_path, {"m.py": """\
+        import threading
+        _lock_a = threading.Lock()
+        _lock_b = threading.Lock()
+
+        def inner():
+            with _lock_a:
+                pass
+
+        def f():
+            with _lock_a:
+                with _lock_b:
+                    pass
+
+        def g():
+            with _lock_b:
+                inner()
+    """}, rule="R016")
+    assert len(found) == 1, found
+    assert "inner()" in found[0].message
+
+
+def test_r017_lock_across_fetch_and_snapshot_twin(tmp_path):
+    found = _findings(tmp_path, {"m.py": """\
+        import threading
+        import jax
+        _lock = threading.Lock()
+
+        def f(x):
+            with _lock:
+                return jax.device_get(x)
+    """}, rule="R017")
+    assert len(found) == 1, found
+    assert "device_get" in found[0].message and "m._lock" in found[0].message
+    # Snapshot-under-the-lock, block-after: the sanctioned shape.
+    found = _findings(tmp_path, {"m.py": """\
+        import threading
+        import jax
+        _lock = threading.Lock()
+        _state = {"x": None}
+
+        def f():
+            with _lock:
+                x = _state["x"]
+            return jax.device_get(x)
+    """}, rule="R017")
+    assert found == [], found
+
+
+def test_r017_lock_across_collective(tmp_path):
+    found = _findings(tmp_path, {"m.py": """\
+        import threading
+        from jax.experimental import multihost_utils
+        _lock = threading.Lock()
+
+        def f(x):
+            with _lock:
+                return multihost_utils.process_allgather(x)
+    """}, rule="R017")
+    assert len(found) == 1, found
+    assert "process_allgather" in found[0].message
+
+
+def test_collective_ops_and_automaton(tmp_path):
+    """The protocol model itself: ordered labeled tokens, and the
+    automaton rendering used by ``fmlint --protocol``."""
+    _, paths = _project(tmp_path, {_PROTO: """\
+        from jax.experimental import multihost_utils
+        from fast_tffm_tpu.parallel.liveness import guarded_collective
+
+        def agree(flag):
+            return guarded_collective(
+                multihost_utils.process_allgather, flag,
+                label="demo/agree")
+
+        def driver(n):
+            guarded_collective(multihost_utils.broadcast_one_to_all,
+                               n, label="demo/pick")
+            for i in range(n):
+                agree(i)
+    """})
+    proj = load_project(parse_files(paths))
+    (q,) = [q for q in proj.functions if q.endswith(".driver")]
+    fn = proj.functions[q]
+    ops = collective_ops(proj, fn, fn.node.body)
+    assert ops[0] == "guarded_collective[demo/pick]"
+    assert ops[1].endswith(".agree()")
+    text = "\n".join(protocol_automaton(proj, q))
+    assert "guarded_collective[demo/pick]" in text
+    assert "for <line" in text
+    # depth-1 inlining expands agree()'s own labeled op
+    assert "guarded_collective[demo/agree]" in text
+
+
+# --- acceptance mutants against the REAL modules ---------------------------
+
+def _read(path):
+    with open(path, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def test_head_is_clean_of_protocol_rules():
+    """Negative twin for all four mutants: the unmutated package holds
+    no R014–R017 findings (the repo gate pins the full surface; this
+    pins the rules specifically so a mutant test failure can't be
+    confused with pre-existing noise)."""
+    found = [f for f in run_paths([FM])
+             if f.rule in ("R014", "R015", "R016", "R017")]
+    assert found == [], "\n".join(f.render() for f in found)
+
+
+def test_r014_mutant_unagreed_restore_walkback():
+    """Plant the PR 4 bug class into the REAL walk-back: drop the
+    _all_agree collective so each rank branches on its own restore
+    outcome — one R014 naming the diverging call pair."""
+    ckpt = os.path.join(FM, "checkpoint.py")
+    src = _read(ckpt)
+    needle = "if self._all_agree(err is None):"
+    assert src.count(needle) == 1, "mutation site drifted"
+    found = run_paths([FM], overlay={
+        ckpt: src.replace(needle, "if err is None:")})
+    assert [f.rule for f in found] == ["R014"], \
+        "\n".join(f.render() for f in found)
+    msg = found[0].message
+    assert found[0].path.endswith("checkpoint.py")
+    assert "_restore_newest_intact" in msg
+    assert "_broadcast_int()" in msg  # the unmatched peer-side op
+
+
+def test_r015_mutant_collective_on_thread():
+    """Move the epoch-override broadcast into a threading.Thread
+    target closure — one R015 at the relocated guarded_collective."""
+    ckpt = os.path.join(FM, "checkpoint.py")
+    src = _read(ckpt)
+    needle = """\
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            from fast_tffm_tpu.parallel.liveness import guarded_collective
+            override = int(guarded_collective(
+                multihost_utils.broadcast_one_to_all,
+                np.int64(override), label="checkpoint/epoch_override"))"""
+    assert needle in src, "mutation site drifted"
+    mutant = """\
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            from fast_tffm_tpu.parallel.liveness import guarded_collective
+            import threading
+            box = {}
+
+            def _bg():
+                box["v"] = int(guarded_collective(
+                    multihost_utils.broadcast_one_to_all,
+                    np.int64(override),
+                    label="checkpoint/epoch_override"))
+
+            t = threading.Thread(target=_bg)
+            t.start()
+            t.join()
+            override = box["v"]"""
+    found = run_paths([FM], overlay={ckpt: src.replace(needle, mutant)})
+    assert [f.rule for f in found] == ["R015"], \
+        "\n".join(f.render() for f in found)
+    assert "guarded_collective" in found[0].message
+    assert "_bg is thread-reachable" in found[0].message
+
+
+def test_r016_mutant_serve_lock_inversion():
+    """Invert the serve dispatcher/reload lock order (submit nests the
+    table lock under the submit lock while the reload path nests them
+    the other way) — one R016 naming both locks with a witness site
+    for each direction."""
+    srv = os.path.join(FM, "serve", "server.py")
+    src = _read(srv)
+    o1 = """\
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("ScorerServer is closed")
+            self._q.put(pending)"""
+    n1 = """\
+        with self._submit_lock:
+            if self._closed:
+                raise RuntimeError("ScorerServer is closed")
+            with self._table_lock:
+                self._q.put(pending)"""
+    o2 = """\
+        with self._table_lock:
+            self._table = table
+            self._vocab_map = vmap
+            self._served_step = int(step)"""
+    n2 = """\
+        with self._table_lock:
+            with self._submit_lock:
+                self._table = table
+                self._vocab_map = vmap
+                self._served_step = int(step)"""
+    assert o1 in src and o2 in src, "mutation sites drifted"
+    found = run_paths([FM], overlay={
+        srv: src.replace(o1, n1).replace(o2, n2)})
+    assert [f.rule for f in found] == ["R016"], \
+        "\n".join(f.render() for f in found)
+    msg = found[0].message
+    assert "ScorerServer._submit_lock" in msg
+    assert "ScorerServer._table_lock" in msg
+    assert "submit()" in msg and "_load_step()" in msg
+
+
+def test_r017_mutant_fetch_under_table_lock():
+    """Hold the serve table lock across the score fetch (undoing the
+    snapshot-then-release design) — one R017 naming the lock and the
+    blocking device_get."""
+    srv = os.path.join(FM, "serve", "server.py")
+    src = _read(srv)
+    needle = """\
+            with self._table_lock:
+                table = self._table
+                step = self._served_step
+                vmap = self._vocab_map
+            with span("serve/flush", examples=n, rung=rung):
+                batch = make_device_batch(block, self._build_cfg,
+                                          batch_size=rung,
+                                          raw_ids=True)
+                if vmap is not None:
+                    batch = vmap.remap(batch)
+                raw = np.asarray(jax.device_get(
+                    self._scorer.score_batch(table, batch)))[:n]"""
+    mutant = """\
+            with self._table_lock:
+                table = self._table
+                step = self._served_step
+                vmap = self._vocab_map
+                with span("serve/flush", examples=n, rung=rung):
+                    batch = make_device_batch(block, self._build_cfg,
+                                              batch_size=rung,
+                                              raw_ids=True)
+                    if vmap is not None:
+                        batch = vmap.remap(batch)
+                    raw = np.asarray(jax.device_get(
+                        self._scorer.score_batch(table, batch)))[:n]"""
+    assert needle in src, "mutation site drifted"
+    found = run_paths([FM], overlay={srv: src.replace(needle, mutant)})
+    assert [f.rule for f in found] == ["R017"], \
+        "\n".join(f.render() for f in found)
+    msg = found[0].message
+    assert "device_get" in msg
+    assert "_flush()" in msg and "ScorerServer._table_lock" in msg
+
+
+# --- tooling: parse cache, --changed closure, CLI flags ---------------------
+
+
+def test_parse_cache_roundtrip_and_invalidation(tmp_path):
+    """The (mtime, size)-keyed AST cache serves unchanged files and
+    invalidates edited ones; the overlay seam never touches it."""
+    from tools.fmlint.core import _parse_one
+    cache = str(tmp_path / "cache")
+    p = tmp_path / "a.py"
+    p.write_text("x = 1\n")
+    src1, tree1, _ = _parse_one(str(p), cache_dir=cache)
+    assert src1 == "x = 1\n" and tree1 is not None
+    assert len(os.listdir(cache)) == 1
+    # Warm hit returns the same content.
+    src2, _tree2, _ = _parse_one(str(p), cache_dir=cache)
+    assert src2 == src1
+    # An edit (size + mtime change) invalidates.
+    p.write_text("y = 2  # edited\n")
+    src3, _, _ = _parse_one(str(p), cache_dir=cache)
+    assert src3 == "y = 2  # edited\n"
+    # Overlay source bypasses the cache and does not poison it.
+    src4, _, _ = _parse_one(str(p), source="z = 3\n", cache_dir=cache)
+    assert src4 == "z = 3\n"
+    src5, _, _ = _parse_one(str(p), cache_dir=cache)
+    assert src5 == "y = 2  # edited\n"
+
+
+def test_full_sweep_wall_time_budget(tmp_path):
+    """ISSUE 16 satellite: the whole-program sweep over the real
+    surface stays inside an interactive wall-time budget, cold cache
+    included (the R014 taint-timeline memoization and the AST cache
+    are what hold this line as the surface grows)."""
+    import time
+    from tools.fmlint.core import default_paths, run_paths
+    cache = str(tmp_path / "cache")
+    t0 = time.perf_counter()
+    cold = run_paths(default_paths(), cache_dir=cache)
+    cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    warm = run_paths(default_paths(), cache_dir=cache)
+    warm_s = time.perf_counter() - t0
+    assert [f.render() for f in cold] == [f.render() for f in warm]
+    # ~4s on the dev box; 6x headroom for slow CI before it trips.
+    assert cold_s < 25.0, f"cold sweep took {cold_s:.1f}s"
+    assert warm_s < 25.0, f"warm sweep took {warm_s:.1f}s"
+
+
+def test_changed_closure_reverse_imports(tmp_path, monkeypatch):
+    """--changed lints the dirty file plus everything that imports it,
+    transitively — and nothing else."""
+    import tools.fmlint.core as core
+    root, paths = _project(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/base.py": "X = 1\n",
+        "pkg/mid.py": "from pkg.base import X\nY = X + 1\n",
+        "pkg/top.py": "from pkg import mid\nZ = mid.Y\n",
+        "pkg/other.py": "W = 0\n",
+    })
+    base = str(tmp_path / "pkg" / "base.py")
+    monkeypatch.setattr(core, "_git_dirty_files", lambda _root: [base])
+    closure = core.changed_closure([root])
+    names = sorted(os.path.basename(f) for f in closure)
+    assert names == ["base.py", "mid.py", "top.py"]
+    monkeypatch.setattr(core, "_git_dirty_files", lambda _root: [])
+    assert core.changed_closure([root]) == []
+
+
+def test_cli_json_out_and_profile(tmp_path, capsys):
+    import json
+
+    from tools.fmlint.core import main
+    ok = tmp_path / "clean.py"
+    ok.write_text("x = 1\n")
+    art = str(tmp_path / "findings.json")
+    assert main([str(ok), "--no-cache", "--no-baseline",
+                 "--json-out", art, "--profile"]) == 0
+    doc = json.load(open(art))
+    assert doc["count"] == 0 and doc["findings"] == []
+    err = capsys.readouterr().err
+    assert "per-stage/per-rule wall time" in err and "total" in err
+
+
+def test_cli_protocol_dump_and_unknown(capsys):
+    """--protocol prints the ordered collective automaton for a real
+    entry point; a typo'd qualname exits 2 with close matches."""
+    from tools.fmlint.core import main
+    assert main(["--protocol",
+                 "fast_tffm_tpu.data.stream.exchange_watermarks"]) == 0
+    out = capsys.readouterr().out
+    assert "guarded_collective[stream/watermark_len]" in out
+    assert "guarded_collective[stream/watermark_merge]" in out
+    assert main(["--protocol", "exchange_watermarks"]) == 2
+    err = capsys.readouterr().err
+    assert "close matches" in err \
+        and "fast_tffm_tpu.data.stream.exchange_watermarks" in err
+
+
+def test_changed_mode_defers_catalog_drift_rules(tmp_path, monkeypatch):
+    """--changed lints a SUBSET, where "emitted nowhere on the
+    surface" proves nothing — the catalog-drift rules (R009/R012) are
+    deferred to the full sweep instead of false-positive firing when
+    the emitting module is outside the closure."""
+    from tools.fmlint.core import run_paths
+    from tools.fmlint.xrules import r009_config_drift, r012_health_catalog
+    assert r009_config_drift.needs_full_surface
+    assert r012_health_catalog.needs_full_surface
+    # The real repo subset that reproduced the misfire: attribution.py
+    # (the catalog) without obs/quality.py (the gate_held emitter).
+    attribution = os.path.join(FM, "obs", "attribution.py")
+    full = run_paths([attribution])
+    assert any(f.rule == "R012" for f in full), \
+        "subset misfire shape drifted — pick another probe module"
+    assert [f for f in run_paths([attribution], partial=True)
+            if f.rule in ("R009", "R012")] == []

@@ -1,0 +1,222 @@
+"""Protocol-trace runtime oracle: the ``collective`` telemetry events
+``guarded_collective`` emits under ``protocol_trace`` (ISSUE 16), and
+the ``fmtrace --collectives`` diff that turns per-rank streams into a
+divergence verdict. Ends with the real 2-process acceptance run: a
+traced dist_train whose ranks must post bit-identical sequences."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import fast_tffm_tpu.parallel.liveness as liveness
+from fast_tffm_tpu.obs.sink import read_events
+from fast_tffm_tpu.obs.telemetry import RunTelemetry, activate
+from fast_tffm_tpu.parallel.liveness import (enable_protocol_trace,
+                                             guarded_collective,
+                                             protocol_trace_enabled)
+from tools.fmtrace import collective_sequences, diff_collectives
+from tools.fmtrace import main as fmtrace_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _reset_trace_state(monkeypatch):
+    """The enable override and the env parse are module-global caches;
+    every test starts from the unset state."""
+    monkeypatch.delenv("FM_PROTOCOL_TRACE", raising=False)
+    monkeypatch.setattr(liveness, "_PROTOCOL_TRACE", None)
+    monkeypatch.setattr(liveness, "_PROTOCOL_ENV", None)
+    monkeypatch.setattr(liveness, "_PROTOCOL_SEQ", 0)
+
+
+def test_trace_switch_precedence(tmp_path, monkeypatch):
+    """enable_protocol_trace() beats the env, the env beats the active
+    run's knob, and the default is off."""
+    assert not protocol_trace_enabled()
+    monkeypatch.setenv("FM_PROTOCOL_TRACE", "1")
+    # The env parse is cached once per process (the check sits on every
+    # collective); flip the cache back to unset to re-read it.
+    monkeypatch.setattr(liveness, "_PROTOCOL_ENV", None)
+    assert protocol_trace_enabled()
+    enable_protocol_trace(False)  # explicit override wins over env
+    assert not protocol_trace_enabled()
+    enable_protocol_trace(True)
+    assert protocol_trace_enabled()
+    # Back to unset: the active telemetry's knob is the fallback.
+    monkeypatch.setattr(liveness, "_PROTOCOL_TRACE", None)
+    monkeypatch.setattr(liveness, "_PROTOCOL_ENV", None)
+    monkeypatch.delenv("FM_PROTOCOL_TRACE")
+    tel = RunTelemetry(str(tmp_path / "m.jsonl"), meta={},
+                       protocol_trace=True)
+    with activate(tel):
+        assert protocol_trace_enabled()
+    assert not protocol_trace_enabled()
+    tel.close(0)
+
+
+def test_env_off_values_do_not_enable(monkeypatch):
+    for raw in ("", "0", "false", "no", " False "):
+        monkeypatch.setattr(liveness, "_PROTOCOL_ENV", None)
+        monkeypatch.setenv("FM_PROTOCOL_TRACE", raw)
+        assert not protocol_trace_enabled(), repr(raw)
+
+
+def test_guarded_collective_emits_ordered_events(tmp_path):
+    """Each traced wrap emits one ``collective`` event BEFORE the op
+    runs, with a per-process monotonic seq, the protocol label, and the
+    wrapped callable's name."""
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={"process_index": 0},
+                       protocol_trace=True)
+
+    def agree(x):
+        return x
+
+    with activate(tel):
+        assert guarded_collective(agree, 7, label="demo/agree") == 7
+        assert guarded_collective(agree, 8, label="demo/pick") == 8
+        # Not a collective program: excluded from the protocol stream.
+        assert guarded_collective(agree, 9, label="score/fetch",
+                                  collective=False) == 9
+    tel.close(0)
+    evs = [r for r in read_events(path) if r.get("event") == "collective"]
+    assert [(e["seq"], e["label"], e["op"]) for e in evs] == [
+        (1, "demo/agree", "agree"), (2, "demo/pick", "agree")]
+
+
+def test_trace_off_emits_nothing(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    tel = RunTelemetry(path, meta={"process_index": 0})
+    with activate(tel):
+        guarded_collective(lambda x: x, 1, label="demo/agree")
+    tel.close(0)
+    assert not [r for r in read_events(path)
+                if r.get("event") == "collective"]
+
+
+def _shard(tmp_path, name, pid, labels, start_seq=1):
+    """A minimal telemetry stream: run_start naming the rank, then one
+    ``collective`` event per label."""
+    path = str(tmp_path / name)
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"event": "run_start", "t": 0.0,
+                             "meta": {"process_index": pid}}) + "\n")
+        for i, label in enumerate(labels):
+            fh.write(json.dumps({"event": "collective", "t": float(i),
+                                 "seq": start_seq + i,
+                                 "label": label}) + "\n")
+    return path
+
+
+def test_collective_sequences_orders_by_seq(tmp_path):
+    # Seq counters, not file order, define the protocol order.
+    path = str(tmp_path / "m.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"event": "run_start", "t": 0.0,
+                             "meta": {"process_index": 3}}) + "\n")
+        for seq, label in ((2, "b"), (1, "a"), (3, "c")):
+            fh.write(json.dumps({"event": "collective", "seq": seq,
+                                 "label": label}) + "\n")
+    assert collective_sequences([path]) == {3: ["a", "b", "c"]}
+
+
+def test_diff_collectives_identical_and_divergent(tmp_path, capsys):
+    a = _shard(tmp_path, "m.jsonl", 0, ["ckpt/agree", "train/step"])
+    b = _shard(tmp_path, "m.jsonl.p1", 1, ["ckpt/agree", "train/step"])
+    assert diff_collectives(collective_sequences([a, b]),
+                            out=sys.stdout) == 0
+    assert "sequences identical" in capsys.readouterr().out
+
+    c = _shard(tmp_path, "n.jsonl.p1", 1, ["ckpt/agree", "ckpt/bcast"])
+    assert diff_collectives(collective_sequences([a, c]),
+                            out=sys.stdout) == 1
+    out = capsys.readouterr().out
+    assert "DIVERGE at position 1" in out
+    assert "rank 0: train/step" in out and "rank 1: ckpt/bcast" in out
+
+
+def test_diff_collectives_short_stream_and_empty(tmp_path, capsys):
+    a = _shard(tmp_path, "m.jsonl", 0, ["ckpt/agree", "train/step"])
+    b = _shard(tmp_path, "m.jsonl.p1", 1, ["ckpt/agree"])
+    assert diff_collectives(collective_sequences([a, b]),
+                            out=sys.stdout) == 1
+    out = capsys.readouterr().out
+    assert "rank 1: <end of sequence>" in out
+    assert diff_collectives({}, out=sys.stdout) == 1
+    assert "no collective events" in capsys.readouterr().out
+
+
+def test_fmtrace_cli_collectives_flag(tmp_path, capsys):
+    a = _shard(tmp_path, "m.jsonl", 0, ["ckpt/agree"])
+    b = _shard(tmp_path, "m.jsonl.p1", 1, ["ckpt/agree"])
+    assert fmtrace_main(["--collectives", a, b]) == 0
+    c = _shard(tmp_path, "d.jsonl", 0, ["ckpt/agree"])
+    d = _shard(tmp_path, "d.jsonl.p1", 1, ["train/step"])
+    assert fmtrace_main(["--collectives", c, d]) == 1
+    # No trace output file side effects in diff mode.
+    assert not [f for f in os.listdir(tmp_path)
+                if f.endswith(".trace.json")]
+
+
+@pytest.mark.slow
+def test_two_process_run_posts_identical_sequences(tmp_path, rng,
+                                                   monkeypatch):
+    """ISSUE 16 acceptance: a REAL 2-process train run under
+    ``FM_PROTOCOL_TRACE`` (the worker subprocesses inherit it; the
+    ``protocol_trace`` knob is the config spelling of the same switch)
+    yields per-rank collective sequences that ``fmtrace --collectives``
+    proves bit-identical — the runtime ground truth for everything
+    R014 checks statically."""
+    from tests.test_multiprocess import (_free_port, _launch_mode,
+                                         _rerun_on_worker_signal)
+    monkeypatch.setenv("FM_PROTOCOL_TRACE", "1")
+
+    @_rerun_on_worker_signal(times=2)
+    def _run(workdir):
+        lines = []
+        for _ in range(97):
+            nnz = rng.integers(2, 8)
+            ids = rng.choice(64, size=nnz, replace=False)
+            lines.append(" ".join(
+                ["1" if rng.random() < 0.5 else "0"]
+                + [f"{i}:{rng.random():.3f}" for i in ids]))
+        data = workdir / "train.txt"
+        data.write_text("\n".join(lines) + "\n")
+        model = workdir / "model" / "fm"
+        metrics = workdir / "m.jsonl"
+        coord = _free_port()
+        cfg = workdir / "dist.cfg"
+        cfg.write_text(f"""
+[General]
+vocabulary_size = 64
+factor_num = 4
+model_file = {model}
+
+[Train]
+train_files = {data}
+validation_files = {data}
+epoch_num = 2
+batch_size = 32
+learning_rate = 0.1
+shuffle = False
+metrics_file = {metrics}
+protocol_trace = true
+
+[Cluster]
+worker_hosts = localhost:{coord - 1000},localhost:{coord - 999}
+""")
+        return _launch_mode(cfg, "train"), metrics
+
+    outs, metrics = _run(tmp_path)
+    assert any("training done" in o for o in outs)
+    shards = [str(metrics), str(metrics) + ".p1"]
+    assert all(os.path.exists(s) for s in shards), shards
+    seqs = collective_sequences(shards)
+    assert sorted(seqs) == [0, 1]
+    assert seqs[0] and seqs[0] == seqs[1], (
+        f"rank0={seqs[0][:10]}... rank1={seqs[1][:10]}...")
+    assert fmtrace_main(["--collectives"] + shards) == 0

@@ -102,9 +102,81 @@ def parse_suppressions(path: str, source: str,
                        bad_pragmas=bad)
 
 
-def _parse_one(path: str, source: Optional[str] = None):
+# --- parse cache -----------------------------------------------------------
+#
+# Parsing + suppression-scanning ~80 modules dominates a no-finding
+# sweep's cost. Each file's (source, tree, suppressions) triple is
+# pickled under .fmlint_cache/ keyed by (mtime_ns, size): an unchanged
+# file is unpickled instead of re-parsed. Bump _CACHE_VERSION when the
+# cached shape changes (pragma grammar, Suppressions layout). A cache
+# that can't be read or written is ignored — caching is an
+# optimization, never a correctness dependency.
+
+_CACHE_VERSION = 1
+
+
+def _cache_key(path: str) -> Optional[tuple]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (_CACHE_VERSION, sys.version_info[:2], st.st_mtime_ns,
+            st.st_size)
+
+
+def _cache_file(cache_dir: str, path: str) -> str:
+    import hashlib
+    return os.path.join(
+        cache_dir, hashlib.sha1(path.encode("utf-8")).hexdigest()
+        + ".pkl")
+
+
+def _cache_get(cache_dir: str, path: str):
+    import pickle
+    key = _cache_key(path)
+    if key is None:
+        return None
+    try:
+        with open(_cache_file(cache_dir, path), "rb") as fh:
+            entry = pickle.load(fh)
+        if entry.get("key") == key:
+            return entry["value"]
+    except Exception:
+        pass
+    return None
+
+
+def _cache_put(cache_dir: str, path: str, value) -> None:
+    import pickle
+    key = _cache_key(path)
+    if key is None:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        target = _cache_file(cache_dir, path)
+        tmp = target + f".tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump({"key": key, "value": value}, fh,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, target)  # atomic: no torn cache entries
+    except Exception:
+        pass
+
+
+def default_cache_dir() -> str:
+    return os.path.join(repo_root(), ".fmlint_cache")
+
+
+def _parse_one(path: str, source: Optional[str] = None,
+               cache_dir: Optional[str] = None):
     """(source, tree, suppressions) for one file, or a one-element
-    R999 finding list when it doesn't parse."""
+    R999 finding list when it doesn't parse. ``source`` (the overlay
+    seam) bypasses the cache entirely."""
+    if source is None and cache_dir is not None:
+        hit = _cache_get(cache_dir, path)
+        if hit is not None:
+            return hit
+    from_disk = source is None
     if source is None:
         with open(path, "r", encoding="utf-8") as fh:
             source = fh.read()
@@ -113,12 +185,15 @@ def _parse_one(path: str, source: Optional[str] = None):
     except SyntaxError as e:
         return source, None, [Finding("R999", path, e.lineno or 0,
                                       f"syntax error: {e.msg}")]
-    return source, tree, parse_suppressions(path, source, tree)
+    result = source, tree, parse_suppressions(path, source, tree)
+    if from_disk and cache_dir is not None:
+        _cache_put(cache_dir, path, result)
+    return result
 
 
 def run_file(path: str) -> List[Finding]:
     """Per-file rules only (R000-R006 + R999). The whole-program pass
-    (R007-R010; tools/fmlint/xrules.py) needs the full surface — use
+    (R007-R017; tools/fmlint/xrules.py) needs the full surface — use
     ``run_paths``."""
     from tools.fmlint.rules import RULES
     source, tree, supp = _parse_one(path)
@@ -157,23 +232,44 @@ def collect_files(paths: Sequence[str]) -> List[str]:
 
 def run_paths(paths: Sequence[str],
               overlay: Optional[Dict[str, str]] = None,
-              baseline: Optional[str] = None) -> List[Finding]:
+              baseline: Optional[str] = None,
+              cache_dir: Optional[str] = None,
+              profile: Optional[Dict[str, float]] = None,
+              partial: bool = False) -> List[Finding]:
     """The whole-program pass: every file parsed ONCE, per-file rules
-    (R000-R006) plus the cross-file rules (R007-R010) over one shared
+    (R000-R006) plus the cross-file rules (R007-R017) over one shared
     project model (tools/fmlint/project.py). ``overlay`` maps absolute
     paths to replacement source (the mutant-testing seam);
     ``baseline`` filters findings recorded in a committed baseline
-    file (gradual adoption — see load_baseline)."""
+    file (gradual adoption — see load_baseline); ``cache_dir`` reuses
+    pickled parses for unchanged files (the CLI passes
+    .fmlint_cache/); ``profile``, when a dict, receives per-stage and
+    per-rule wall seconds; ``partial`` marks a subset surface
+    (--changed): rules whose contract is "X appears NOWHERE on the
+    surface" (the R009/R012 stale/drift directions) are skipped —
+    absence over a subset proves nothing, and the full sweep remains
+    the gate."""
+    import time as _time
     from tools.fmlint.rules import RULES
     from tools.fmlint.project import load_project
     from tools.fmlint.xrules import PROGRAM_RULES
+
+    def clocked(name: str, fn, *a):
+        t0 = _time.perf_counter()
+        out = fn(*a)
+        if profile is not None:
+            profile[name] = profile.get(name, 0.0) \
+                + _time.perf_counter() - t0
+        return out
+
     overlay = {os.path.abspath(k): v for k, v in (overlay or {}).items()}
     found: List[Finding] = []
     entries = []                      # (abspath, source, tree)
     supp_by_path: Dict[str, Suppressions] = {}
     for f in collect_files(paths):
         ap = os.path.abspath(f)
-        source, tree, supp = _parse_one(ap, overlay.get(ap))
+        source, tree, supp = clocked(
+            "parse", _parse_one, ap, overlay.get(ap), cache_dir)
         if tree is None:
             found.extend(supp)        # R999: excluded from the project
             continue
@@ -181,11 +277,14 @@ def run_paths(paths: Sequence[str],
         supp_by_path[ap] = supp
         found.extend(supp.bad_pragmas)
         for rule_fn in RULES:
-            found.extend(x for x in rule_fn(ap, tree)
+            found.extend(x for x in clocked(rule_fn.__name__,
+                                            rule_fn, ap, tree)
                          if not supp.allows(x))
-    proj = load_project(entries)
+    proj = clocked("load_project", load_project, entries)
     for rule_fn in PROGRAM_RULES:
-        for x in rule_fn(proj):
+        if partial and getattr(rule_fn, "needs_full_surface", False):
+            continue
+        for x in clocked(rule_fn.__name__, rule_fn, proj):
             supp = supp_by_path.get(os.path.abspath(x.path))
             # Non-python findings (sample.cfg drift) carry no pragma
             # surface; the baseline below is their suppression path.
@@ -194,6 +293,97 @@ def run_paths(paths: Sequence[str],
     if baseline:
         found = apply_baseline(found, baseline, proj.root)
     return sorted(found, key=lambda f: (f.path, f.line, f.rule))
+
+
+# --- incremental mode (--changed) ------------------------------------------
+
+def _git_dirty_files(root: str) -> List[str]:
+    """Absolute paths of git-dirty (modified/added/renamed/untracked)
+    .py files under ``root``; [] when git is unavailable."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30, check=True
+        ).stdout
+    except Exception:
+        return []
+    dirty: List[str] = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        rel = line[3:]
+        if " -> " in rel:             # rename: lint the new name
+            rel = rel.split(" -> ", 1)[1]
+        rel = rel.strip().strip('"')
+        if rel.endswith(".py"):
+            dirty.append(os.path.join(root, rel))
+    return dirty
+
+
+def _imported_names(tree: ast.AST, modname: str) -> Set[str]:
+    """Dotted module names this tree imports (absolute form),
+    relative imports resolved against ``modname``."""
+    out: Set[str] = set()
+    pkg_parts = modname.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            out.update(alias.name for alias in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[:len(pkg_parts) - node.level]
+                stem = ".".join(base + ([node.module]
+                                        if node.module else []))
+            else:
+                stem = node.module or ""
+            if stem:
+                out.add(stem)
+                # `from pkg import name` may bind the submodule
+                out.update(f"{stem}.{alias.name}"
+                           for alias in node.names)
+    return out
+
+
+def changed_closure(paths: Sequence[str],
+                    cache_dir: Optional[str] = None) -> List[str]:
+    """The git-dirty .py files of the surface plus their reverse-
+    import closure (everything that imports them, transitively) — the
+    files whose findings an edit can change. Program rules then run
+    over this subset only: the fast inner-loop check; the full sweep
+    remains the gate."""
+    from tools.fmlint.project import package_root
+    files = [os.path.abspath(f) for f in collect_files(paths)]
+    if not files:
+        return []
+    root = package_root(os.path.commonpath(
+        [os.path.dirname(f) for f in files]))
+    dirty = {f for f in _git_dirty_files(repo_root()) if f in set(files)}
+    if not dirty:
+        return []
+
+    def modname(ap: str) -> str:
+        rel = os.path.relpath(ap, root)
+        return rel[:-3].replace(os.sep, ".")
+
+    by_mod = {modname(f): f for f in files}
+    importers: Dict[str, Set[str]] = {}   # file -> files importing it
+    for f in files:
+        parsed = _parse_one(f, cache_dir=cache_dir)
+        tree = parsed[1]
+        if tree is None:
+            continue
+        for name in _imported_names(tree, modname(f)):
+            target = by_mod.get(name)
+            if target is not None and target != f:
+                importers.setdefault(target, set()).add(f)
+    closure = set(dirty)
+    frontier = list(dirty)
+    while frontier:
+        for dep in importers.get(frontier.pop(), ()):
+            if dep not in closure:
+                closure.add(dep)
+                frontier.append(dep)
+    return sorted(closure)
 
 
 # --- committed baseline ----------------------------------------------------
@@ -281,8 +471,10 @@ def default_baseline_path() -> Optional[str]:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    as_json = update = False
+    as_json = update = changed = do_profile = False
+    json_out = protocol = None
     baseline = default_baseline_path()
+    cache_dir: Optional[str] = default_cache_dir()
     paths: List[str] = []
     i = 0
     while i < len(args):
@@ -293,22 +485,75 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             update = True
         elif a == "--no-baseline":
             baseline = None
-        elif a == "--baseline":
+        elif a == "--no-cache":
+            cache_dir = None
+        elif a == "--changed":
+            changed = True
+        elif a == "--profile":
+            do_profile = True
+        elif a in ("--baseline", "--json-out", "--protocol"):
+            flag = a
             i += 1
             if i >= len(args):
-                print("fmlint: --baseline needs a path",
-                      file=sys.stderr)
+                print(f"fmlint: {flag} needs a value", file=sys.stderr)
                 return 2
-            baseline = args[i]
+            if flag == "--baseline":
+                baseline = args[i]
+            elif flag == "--json-out":
+                json_out = args[i]
+            else:
+                protocol = args[i]
         else:
             paths.append(a)
         i += 1
+    if protocol is not None:
+        # Dump the protocol automaton for one driver entry point
+        # (qualified name, e.g. fast_tffm_tpu.train._train_session).
+        from tools.fmlint.project import (load_project,
+                                          protocol_automaton)
+        entries = []
+        for f in collect_files(paths or default_paths()):
+            ap = os.path.abspath(f)
+            source, tree, _supp = _parse_one(ap, cache_dir=cache_dir)
+            if tree is not None:
+                entries.append((ap, source, tree))
+        proj = load_project(entries)
+        if protocol not in proj.functions:
+            close = sorted(q for q in proj.functions
+                           if q.endswith("." + protocol)
+                           or protocol in q)[:8]
+            print(f"fmlint: unknown function {protocol!r}"
+                  + (f"; close matches: {', '.join(close)}"
+                     if close else ""), file=sys.stderr)
+            return 2
+        for line in protocol_automaton(proj, protocol):
+            print(line)
+        return 0
+    lint_paths = paths or default_paths()
+    if changed:
+        lint_paths = changed_closure(lint_paths, cache_dir=cache_dir)
+        if not lint_paths:
+            print("fmlint: no git-dirty files on the lint surface",
+                  file=sys.stderr)
+            return 0
+        print(f"fmlint: --changed linting {len(lint_paths)} file(s) "
+              "(catalog-drift rules deferred to the full sweep)",
+              file=sys.stderr)
+    prof: Optional[Dict[str, float]] = {} if do_profile else None
     try:
-        findings = run_paths(paths or default_paths(),
-                             baseline=None if update else baseline)
+        findings = run_paths(lint_paths,
+                             baseline=None if update else baseline,
+                             cache_dir=cache_dir, profile=prof,
+                             partial=changed)
     except FileNotFoundError as e:
         print(e, file=sys.stderr)
         return 2
+    if prof is not None:
+        total = sum(prof.values())
+        print("fmlint: per-stage/per-rule wall time:", file=sys.stderr)
+        for name, secs in sorted(prof.items(), key=lambda kv: -kv[1]):
+            print(f"  {secs * 1000:8.1f} ms  {name}", file=sys.stderr)
+        print(f"  {total * 1000:8.1f} ms  total", file=sys.stderr)
     if update:
         target = baseline or os.path.join(repo_root(), "tools",
                                           "fmlint", "baseline.txt")
@@ -318,12 +563,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{'y' if len(findings) == 1 else 'ies'} to {target}",
               file=sys.stderr)
         return 0
-    if as_json:
+    if as_json or json_out is not None:
         import json
-        print(json.dumps({
+        payload = json.dumps({
             "findings": [dataclasses.asdict(f) for f in findings],
-            "count": len(findings)}, indent=2))
-    else:
+            "count": len(findings)}, indent=2)
+        if json_out is not None:
+            # CI artifact: machine-readable findings alongside the
+            # human rendering (make lint publishes this).
+            with open(json_out, "w", encoding="utf-8") as fh:
+                fh.write(payload + "\n")
+        if as_json:
+            print(payload)
+    if not as_json:
         for f in findings:
             print(f.render())
     if findings:

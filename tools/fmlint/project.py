@@ -1,5 +1,6 @@
 """fmlint whole-program layer: the project loader the cross-file rules
-(tools/fmlint/xrules.py, R007-R010) consume.
+(tools/fmlint/xrules.py, R007-R012 and the R014-R017 protocol/lock
+model checker) consume.
 
 Every module on the lint surface is parsed ONCE into a ``Project``:
 
@@ -49,6 +50,13 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 COLLECTIVE_NAMES = ("process_allgather", "broadcast_one_to_all",
                     "sync_global_devices", "guarded_collective")
 
+# Blocking device fetches: a D2H transfer (or a wait for one) parks the
+# calling thread until the producing program completes — on a dead
+# cluster that is an indefinite block, and under a lock (R017) it
+# wedges every other thread contending for the lock behind device
+# latency.
+FETCH_NAMES = ("block_until_ready", "bulk_fetch", "device_get")
+
 # In-place mutator methods: a call to one of these on a shared object
 # is a write even though no assignment appears.
 _MUTATORS = frozenset({
@@ -67,6 +75,27 @@ class SharedWrite:
 
 
 @dataclasses.dataclass
+class LockAcquire:
+    """One ``with <lock>:`` acquisition, with the locks already held
+    lexically at that point (outermost first) — the raw edges of the
+    R016 lock-order graph."""
+    line: int
+    lock: str                  # normalized identity, e.g.
+    #                            "pkg.serve.server.ScorerServer._lock"
+    held: Tuple[str, ...]      # locks held when this one is taken
+
+
+@dataclasses.dataclass
+class LockedCall:
+    """One call made while holding at least one lock (R016's
+    interprocedural edges; R017's held-across-blocking-op evidence)."""
+    line: int
+    locks: Tuple[str, ...]     # held locks, outermost first
+    basename: Optional[str]    # the called name ("device_get", ...)
+    callee: Optional[str]      # resolved qualname, if provable
+
+
+@dataclasses.dataclass
 class FunctionInfo:
     qualname: str
     module: "ModuleInfo"
@@ -76,8 +105,17 @@ class FunctionInfo:
     nested: Dict[str, str] = dataclasses.field(default_factory=dict)
     calls: Set[str] = dataclasses.field(default_factory=set)
     direct_collectives: Set[str] = dataclasses.field(default_factory=set)
+    # (line, kind) per direct collective call site, in source order —
+    # R015 anchors findings here; the protocol extraction orders them.
+    collective_sites: List[Tuple[int, str]] = dataclasses.field(
+        default_factory=list)
+    direct_fetches: Set[str] = dataclasses.field(default_factory=set)
     thread_targets: Set[str] = dataclasses.field(default_factory=set)
     shared_writes: List[SharedWrite] = dataclasses.field(
+        default_factory=list)
+    lock_acquires: List[LockAcquire] = dataclasses.field(
+        default_factory=list)
+    locked_calls: List[LockedCall] = dataclasses.field(
         default_factory=list)
 
     @property
@@ -121,6 +159,8 @@ class Project:
         self.by_path: Dict[str, ModuleInfo] = {}
         self.functions: Dict[str, FunctionInfo] = {}
         self.may_collectives: Dict[str, Set[str]] = {}
+        self.may_locks: Dict[str, Set[str]] = {}
+        self.may_fetch: Set[str] = set()
         self.thread_funcs: Set[str] = set()
         self.env_reads: List[EnvRead] = []
         self.knob_reads: List[KnobRead] = []
@@ -187,6 +227,8 @@ def load_project(entries: Sequence[Tuple[str, str, ast.Module]],
         _analyze_function(proj, fn)
     _fixpoint_collectives(proj)
     _fixpoint_threads(proj)
+    _fixpoint_locks(proj)
+    _fixpoint_fetch(proj)
     return proj
 
 
@@ -374,42 +416,76 @@ def _is_lock_expr(expr) -> bool:
     return False
 
 
+def lock_identity(fn: FunctionInfo, expr) -> Optional[str]:
+    """Normalized identity of the lock a ``with`` item holds, for the
+    R016 lock graph: ``self._lock`` in a method of C in module m is
+    ``m.C._lock`` (every instance shares the ordering discipline, so
+    instances collapse into their class), a module-global ``_lock`` is
+    ``m._lock``, and an imported module's lock resolves through the
+    import table. Returns None when no lock-ish name is present."""
+    mod = fn.module
+    parts = _dotted(expr)
+    if parts is None:
+        # Subscripted / computed manager (`with self._locks[i]:`):
+        # anchor on the first lock-ish name found.
+        for n in ast.walk(expr):
+            name = None
+            if isinstance(n, ast.Name):
+                name = n.id
+            elif isinstance(n, ast.Attribute):
+                name = n.attr
+            if name is not None and "lock" in name.lower():
+                return f"{mod.modname}.{name}"
+        return None
+    if parts[0] in ("self", "cls"):
+        owner = fn.cls if fn.cls is not None else fn.name
+        return ".".join([mod.modname, owner] + parts[1:])
+    tgt = mod.imports.get(parts[0])
+    if tgt is not None and len(parts) > 1:
+        return ".".join([tgt] + parts[1:])
+    return ".".join([mod.modname] + parts)
+
+
 def _analyze_function(proj: Project, fn: FunctionInfo) -> None:
     """One pass over the function's OWN statements (nested defs are
     their own FunctionInfo) collecting calls, collective seeds, thread
-    targets, shared writes, and env/knob reads."""
+    targets, shared writes, lock scopes, and env/knob reads."""
     own_nested = {proj.functions[q].node for q in fn.nested.values()}
 
-    def walk(node, lock_depth: int):
+    def walk(node, held: Tuple[str, ...]):
         for child in ast.iter_child_nodes(node):
             if child not in own_nested:
-                handle(child, lock_depth)
+                handle(child, held)
 
-    def handle(child, lock_depth: int):
+    def handle(child, held: Tuple[str, ...]):
         if isinstance(child, ast.With):
-            depth = lock_depth + (1 if any(
-                _is_lock_expr(i.context_expr) for i in child.items)
-                else 0)
+            inner = held
             for item in child.items:
-                walk(item, lock_depth)
+                walk(item, held)
+                if _is_lock_expr(item.context_expr):
+                    lid = lock_identity(fn, item.context_expr)
+                    if lid is not None:
+                        fn.lock_acquires.append(LockAcquire(
+                            line=child.lineno, lock=lid, held=inner))
+                        inner = inner + (lid,)
             for s in child.body:
                 # Through handle(), not walk(): a With nested directly
-                # in this body must get its own lock-depth branch.
-                handle(s, depth)
+                # in this body must get its own held-locks branch.
+                handle(s, inner)
             return
-        _visit(child, lock_depth)
-        walk(child, lock_depth)
+        _visit(child, held)
+        walk(child, held)
 
-    def record_write(node, target: str, lock_depth: int):
+    def record_write(node, target: str, held: Tuple[str, ...]):
         fn.shared_writes.append(SharedWrite(
-            line=node.lineno, target=target, locked=lock_depth > 0))
+            line=node.lineno, target=target, locked=bool(held)))
 
     declared_global: Set[str] = set()
     for n in ast.walk(fn.node):
         if isinstance(n, ast.Global):
             declared_global.update(n.names)
 
-    def _visit(child, lock_depth: int):
+    def _visit(child, held: Tuple[str, ...]):
         if isinstance(child, ast.Call):
             callee = resolve_call(proj, fn, child.func)
             if callee is not None:
@@ -417,6 +493,13 @@ def _analyze_function(proj: Project, fn: FunctionInfo) -> None:
             base = _call_basename(child.func)
             if base in COLLECTIVE_NAMES:
                 fn.direct_collectives.add(base)
+                fn.collective_sites.append((child.lineno, base))
+            if base in FETCH_NAMES:
+                fn.direct_fetches.add(base)
+            if held and (base is not None or callee is not None):
+                fn.locked_calls.append(LockedCall(
+                    line=child.lineno, locks=held, basename=base,
+                    callee=callee))
             if base == "Thread":
                 for kw in child.keywords:
                     if kw.arg == "target":
@@ -428,10 +511,10 @@ def _analyze_function(proj: Project, fn: FunctionInfo) -> None:
                     and child.func.attr in _MUTATORS):
                 parts = _dotted(child.func.value)
                 if parts and parts[0] == "self" and len(parts) >= 2:
-                    record_write(child, ".".join(parts), lock_depth)
+                    record_write(child, ".".join(parts), held)
                 elif (parts and len(parts) == 1
                       and parts[0] in fn.module.globals):
-                    record_write(child, parts[0], lock_depth)
+                    record_write(child, parts[0], held)
             _scan_env_read(proj, fn, child)
         elif isinstance(child, (ast.Assign, ast.AugAssign)):
             targets = (child.targets if isinstance(child, ast.Assign)
@@ -447,23 +530,23 @@ def _analyze_function(proj: Project, fn: FunctionInfo) -> None:
                         parts = _dotted(n)
                         if parts and parts[0] == "self":
                             record_write(child, ".".join(parts),
-                                         lock_depth)
+                                         held)
                     elif (isinstance(n, ast.Name)
                           and isinstance(getattr(n, "ctx", None),
                                          ast.Store)
                           and n.id in declared_global):
-                        record_write(child, n.id, lock_depth)
+                        record_write(child, n.id, held)
             # subscript store on a module global: G[k] = v
             for t in targets:
                 if (isinstance(t, ast.Subscript)
                         and isinstance(t.value, ast.Name)
                         and t.value.id in fn.module.globals
                         and t.value.id not in declared_global):
-                    record_write(child, t.value.id, lock_depth)
+                    record_write(child, t.value.id, held)
         elif isinstance(child, ast.Attribute):
             _scan_knob_read(proj, fn, child)
 
-    walk(fn.node, 0)
+    walk(fn.node, ())
 
 
 def _scan_env_read(proj: Project, fn: FunctionInfo,
@@ -528,3 +611,171 @@ def _fixpoint_threads(proj: Project) -> None:
                     on_thread.add(callee)
                     changed = True
     proj.thread_funcs = on_thread
+
+
+def _fixpoint_locks(proj: Project) -> None:
+    """``may_locks[q]`` — locks a call to ``q`` may transitively
+    acquire (the R016 interprocedural edge source)."""
+    may = {q: {a.lock for a in f.lock_acquires}
+           for q, f in proj.functions.items()}
+    changed = True
+    while changed:
+        changed = False
+        for q, f in proj.functions.items():
+            for callee in f.calls:
+                extra = may.get(callee)
+                if extra and not extra <= may[q]:
+                    may[q] |= extra
+                    changed = True
+    proj.may_locks = may
+
+
+def _fixpoint_fetch(proj: Project) -> None:
+    """Functions that may (transitively) execute a blocking device
+    fetch (FETCH_NAMES) — R017's held-across-fetch reachability."""
+    fetch = {q for q, f in proj.functions.items() if f.direct_fetches}
+    changed = True
+    while changed:
+        changed = False
+        for q, f in proj.functions.items():
+            if q in fetch:
+                continue
+            if any(c in fetch for c in f.calls):
+                fetch.add(q)
+                changed = True
+    proj.may_fetch = fetch
+
+
+# --- protocol extraction ---------------------------------------------------
+#
+# The collective-protocol model (R014, `python -m tools.fmlint
+# --protocol`): each function's body is read as an ordered sequence of
+# collective operations. A direct call site becomes a concrete op
+# token — the collective kind plus its static ``label=`` where one is
+# written (`guarded_collective[lockstep/window_fill]`) — and a resolved
+# call into a function that may itself execute collectives becomes an
+# opaque sub-protocol token (`ckpt._broadcast_int()`): its INTERNAL
+# order is that function's own protocol, checked where it is defined.
+# Rank-invariance of a whole driver entry point then decomposes into a
+# per-branch-point obligation: at every conditional either both arms
+# carry the same op sequence, or the condition is rank-uniform
+# (broadcast-produced / process_count / constant) — which is exactly
+# what R014 discharges branch by branch.
+
+def _static_label(call: ast.Call) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "label" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def op_token(proj: Project, fn: FunctionInfo,
+             call: ast.Call) -> Optional[str]:
+    """The protocol-op token for one call node, or None if the call
+    provably executes no collective."""
+    base = _call_basename(call.func)
+    if base in COLLECTIVE_NAMES:
+        label = _static_label(call)
+        return f"{base}[{label}]" if label else base
+    callee = resolve_call(proj, fn, call.func)
+    if callee is not None and proj.collectives_of(callee):
+        return f"{callee}()"
+    return None
+
+
+def collective_ops(proj: Project, fn: FunctionInfo,
+                   stmts: Sequence[ast.stmt]) -> List[str]:
+    """Ordered op tokens for a statement list (position-sorted, nested
+    defs excluded: defining a closure executes nothing)."""
+    found: List[Tuple[int, int, str]] = []
+
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call):
+                tok = op_token(proj, fn, child)
+                if tok is not None:
+                    found.append((child.lineno, child.col_offset, tok))
+            visit(child)
+
+    for stmt in stmts:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(stmt, ast.Call):  # bare-expression guard
+            tok = op_token(proj, fn, stmt)
+            if tok is not None:
+                found.append((stmt.lineno, stmt.col_offset, tok))
+        visit(stmt)
+    return [t for _, _, t in sorted(found)]
+
+
+def protocol_automaton(proj: Project, qualname: str,
+                       depth: int = 2) -> List[str]:
+    """Human-readable protocol automaton for one entry point: the
+    ordered collective ops with branch/loop/try structure, sub-protocol
+    calls inlined ``depth`` levels deep. The ``--protocol`` CLI view —
+    what a reviewer used to reconstruct by hand for every PR touching
+    the multi-process layer."""
+    fn = proj.functions.get(qualname)
+    if fn is None:
+        return [f"<unknown function {qualname}>"]
+    lines: List[str] = [f"protocol of {qualname}:"]
+    seen: Set[str] = {qualname}
+
+    def emit(ctx: FunctionInfo, stmts: Sequence[ast.stmt],
+             indent: int, d: int) -> None:
+        pad = "  " * indent
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            own_ops = collective_ops(proj, ctx, [stmt])
+            if not own_ops:
+                continue
+            if isinstance(stmt, ast.If):
+                lines.append(f"{pad}if <line {stmt.lineno}>:")
+                emit(ctx, stmt.body, indent + 1, d)
+                if stmt.orelse:
+                    lines.append(f"{pad}else:")
+                    emit(ctx, stmt.orelse, indent + 1, d)
+            elif isinstance(stmt, (ast.While, ast.For)):
+                kind = ("while" if isinstance(stmt, ast.While)
+                        else "for")
+                lines.append(f"{pad}{kind} <line {stmt.lineno}>:")
+                emit(ctx, stmt.body, indent + 1, d)
+                if stmt.orelse:
+                    lines.append(f"{pad}else:")
+                    emit(ctx, stmt.orelse, indent + 1, d)
+            elif isinstance(stmt, ast.Try):
+                lines.append(f"{pad}try:")
+                emit(ctx, stmt.body, indent + 1, d)
+                for h in stmt.handlers:
+                    lines.append(f"{pad}except <line {h.lineno}>:")
+                    emit(ctx, h.body, indent + 1, d)
+                if stmt.orelse:
+                    lines.append(f"{pad}else:")
+                    emit(ctx, stmt.orelse, indent + 1, d)
+                if stmt.finalbody:
+                    lines.append(f"{pad}finally:")
+                    emit(ctx, stmt.finalbody, indent + 1, d)
+            elif isinstance(stmt, ast.With):
+                emit(ctx, stmt.body, indent, d)
+            else:
+                for tok in own_ops:
+                    inlined = False
+                    if tok.endswith("()") and d > 0:
+                        callee = tok[:-2]
+                        sub = proj.functions.get(callee)
+                        if sub is not None and callee not in seen:
+                            seen.add(callee)
+                            lines.append(f"{pad}{tok} -> inlined:")
+                            emit(sub, sub.node.body, indent + 1, d - 1)
+                            inlined = True
+                    if not inlined:
+                        lines.append(f"{pad}{tok}")
+    emit(fn, fn.node.body, 1, depth)
+    return lines

@@ -204,13 +204,19 @@ def r005_ckpt_delete(path: str, tree: ast.AST) -> List[Finding]:
 
 
 # R006 scope: the modules whose blocking host collectives can park a
-# whole cluster — the drivers, the lockstep protocol, and the restore
-# broadcasts. parallel/liveness.py is the guard's own implementation
-# (it receives collectives as arguments, never names them bare).
+# whole cluster — the drivers, the lockstep protocol, the restore
+# broadcasts, and (post the wire/stream PRs) the data plane's own
+# agreement primitives: data/stream.py OWNS broadcast_blob /
+# allgather_blob, and wire.py is the packed-transfer layer those
+# payloads ride. parallel/liveness.py is the guard's own
+# implementation (it receives collectives as arguments, never names
+# them bare).
 R006_MODULE_SUFFIXES = (
     "fast_tffm_tpu/train.py",
     "fast_tffm_tpu/predict.py",
     "fast_tffm_tpu/checkpoint.py",
+    "fast_tffm_tpu/data/stream.py",
+    "fast_tffm_tpu/wire.py",
 )
 R006_PACKAGE_FRAGMENTS = ("fast_tffm_tpu/parallel/",)
 R006_COLLECTIVES = ("process_allgather", "broadcast_one_to_all",

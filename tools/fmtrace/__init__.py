@@ -1,6 +1,13 @@
 """fmtrace — export a run's metrics JSONL stream to Perfetto.
 
     python -m tools.fmtrace <metrics.jsonl> [more shards...] [-o out.json]
+    python -m tools.fmtrace --collectives <metrics.jsonl> <metrics>.p*
+
+The second form skips the Perfetto export and diffs the per-rank
+collective sequences a ``protocol_trace = true`` run records (exit 1
+naming the first mismatching rank/position/label) — the runtime oracle
+for fmlint's R014 protocol checker, and the first diagnostic for a
+hung multi-host cluster.
 
 Converts the obs/ telemetry stream (spans, gauges, scalars, health and
 crash events) into Chrome trace-event JSON loadable in ui.perfetto.dev
@@ -144,6 +151,60 @@ def _instant(name: str, t: float, pid: int,
     return rec
 
 
+def collective_sequences(paths: Sequence[str]
+                         ) -> Dict[int, List[str]]:
+    """Per-rank ordered collective label sequences from the
+    ``collective`` events a run under ``protocol_trace = true`` (or
+    ``FM_PROTOCOL_TRACE=1``) emits — process index -> labels ordered
+    by the emitting rank's own sequence counter."""
+    raw: Dict[int, List[tuple]] = {}
+    for path in paths:
+        pid = 0  # until a run_start announces the real process index
+        for rec in read_events(path):
+            ev = rec.get("event")
+            if ev == "run_start":
+                meta = rec.get("meta") or {}
+                pid = int(meta.get("process_index") or 0)
+            elif ev == "collective":
+                raw.setdefault(pid, []).append(
+                    (int(rec.get("seq", 0)),
+                     str(rec.get("label", "?"))))
+    return {pid: [label for _, label in sorted(entries)]
+            for pid, entries in raw.items()}
+
+
+def diff_collectives(seqs: Dict[int, List[str]],
+                     out=None) -> int:
+    """The protocol-divergence verdict fmlint R014 proves statically,
+    checked against a real run: 0 when every rank posted the
+    bit-identical collective sequence, 1 with the first mismatching
+    (rank, position, label) pair named otherwise. The first divergent
+    entry IS the deadlock diagnosis: the rank whose label differs (or
+    whose stream ended early) is the one whose peers are parked."""
+    out = out if out is not None else sys.stderr
+    if not seqs:
+        print("no collective events found — was the run traced? "
+              "(protocol_trace = true, or FM_PROTOCOL_TRACE=1)",
+              file=out)
+        return 1
+    pids = sorted(seqs)
+    n = max(len(seqs[p]) for p in pids)
+    for i in range(n):
+        at = {p: (seqs[p][i] if i < len(seqs[p]) else None)
+              for p in pids}
+        if len(set(at.values())) > 1:
+            print(f"collective sequences DIVERGE at position {i}:",
+                  file=out)
+            for p in pids:
+                label = at[p] if at[p] is not None else \
+                    "<end of sequence>"
+                print(f"  rank {p}: {label}", file=out)
+            return 1
+    print(f"{len(pids)} rank(s), {n} collective(s) each — "
+          "sequences identical", file=out)
+    return 0
+
+
 def convert(paths: Sequence[str], out_path: str) -> int:
     """Write the Perfetto JSON for ``paths``; returns the event count."""
     events = to_trace_events(paths)
@@ -161,9 +222,15 @@ def main(argv=None) -> int:
                          "plus its .p<i> worker shards (globs ok)")
     ap.add_argument("-o", "--out", default=None,
                     help="output path (default: <first file>.trace.json)")
+    ap.add_argument("--collectives", action="store_true",
+                    help="diff the per-rank collective sequences "
+                         "(protocol_trace runs) instead of exporting "
+                         "a Perfetto trace; exit 1 on divergence")
     args = ap.parse_args(argv)
     # Shared glob + fail-loudly-on-unreadable policy (tools/__init__).
     files = expand_stream_args(args.files)
+    if args.collectives:
+        return diff_collectives(collective_sequences(files))
     out_path = args.out or files[0] + ".trace.json"
     n = convert(files, out_path)
     print(f"wrote {n} trace events from {len(files)} file(s) to "
