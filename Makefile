@@ -38,6 +38,11 @@
 #                   control), plus the joiner-dies-mid-rendezvous leg
 #   make bench-multihost  multi-host scaling-efficiency row: real 1-
 #                   and 2-process localhost clusters, per-worker rate
+#   make bench-diff OLD=a.json NEW=b.json  per-row regression diff of
+#                   two bench artifacts (exit 1 past TOLERANCE=0.85)
+#   make anatomy METRICS=path.jsonl  clock-aligned cross-rank step
+#                   anatomy report from a traced run's metrics shards
+#                   (fmtrace --anatomy; needs trace_spans = true)
 #   make clean
 
 CXX ?= g++
@@ -93,7 +98,15 @@ grow-soak: $(SO)
 bench-multihost: $(SO)
 	JAX_PLATFORMS=cpu python bench.py --multihost
 
+TOLERANCE ?= 0.85
+bench-diff:
+	python bench.py --compare $(OLD) $(NEW) --tolerance $(TOLERANCE)
+
+METRICS ?= metrics.jsonl
+anatomy:
+	python -m tools.fmtrace --anatomy $(METRICS) $(wildcard $(METRICS).p*)
+
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict bench-vocab bench-wire bench-multihost lint chaos stream-soak serve serve-soak slo-soak grow-soak clean
+.PHONY: all test bench bench-host bench-predict bench-vocab bench-wire bench-multihost bench-diff anatomy lint chaos stream-soak serve serve-soak slo-soak grow-soak clean

@@ -1137,7 +1137,8 @@ def multihost_main():
             s.bind(("127.0.0.1", 0))
             return s.getsockname()[1]
 
-    from fast_tffm_tpu.obs.attribution import summarize
+    from fast_tffm_tpu.obs.attribution import (efficiency_table,
+                                               summarize)
 
     def loop_rate(paths) -> float:
         """Examples per WORKER-second: summarize() sums both the
@@ -1187,6 +1188,7 @@ learning_rate = 0.05
 shuffle = False
 log_steps = 0
 metrics_file = {metrics}
+trace_spans = True
 max_features_per_example = 64
 
 [Cluster]
@@ -1209,6 +1211,41 @@ worker_hosts = {hosts}
                                   for i in range(1, w)
                                   if os.path.exists(f"{metrics}.p{i}")]
             results[w] = loop_rate(shards)
+            if w == 2:
+                # Attach the step-anatomy phase breakdown so the
+                # efficiency row carries its own WHY: the anatomy/*
+                # gauges the workers pre-aggregate at barrier flushes
+                # say where the lost fraction went (fmstat EFFICIENCY
+                # and fmtrace --anatomy read the same surface).
+                eff = efficiency_table(summarize(shards))
+                from fast_tffm_tpu.obs import anatomy as anat_mod
+                # The 1-worker leg's rate is the baseline that turns
+                # the trace replay's coordination efficiency into the
+                # ABSOLUTE per-worker number (it prices the stall
+                # inside the dispatched program, which host spans
+                # cannot see) — directly comparable to this row's
+                # counter-derived "value".
+                rep = anat_mod.report(shards,
+                                      baseline_eps=results.get(1))
+                anatomy = {
+                    "verdict": rep.get("verdict"),
+                    "efficiency": (round(rep["efficiency"], 3)
+                                   if "efficiency" in rep else None),
+                    "efficiency_vs_single": (
+                        round(rep["efficiency_vs_single"], 3)
+                        if rep.get("efficiency_vs_single") is not None
+                        else None),
+                    "straggler_rank": rep.get("straggler_rank"),
+                    "per_worker": {
+                        f"p{p}": {
+                            "efficiency": round(r["efficiency"], 3),
+                            "phase_fractions": {
+                                k: round(v / r["wall_seconds"], 3)
+                                for k, v in r["phases"].items()
+                                if v},
+                        } for p, r in (eff["ranks"].items()
+                                       if eff else ())},
+                } if (eff or "efficiency" in rep) else None
     r1, r2 = results.get(1, 0.0), results.get(2, 0.0)
     print(json.dumps({
         "metric": "multihost_scaling_efficiency",
@@ -1217,7 +1254,105 @@ worker_hosts = {hosts}
         "single_process_eps": round(r1, 1),
         "two_worker_per_worker_eps": round(r2, 1),
         "examples": n_lines * epochs,
+        "anatomy": anatomy,
     }))
+
+
+# Bench-row names matching one of these fragments are lower-is-better
+# (latencies, per-example costs); everything else is a rate or a count
+# where bigger is fine. --compare's direction heuristic.
+_LOWER_BETTER = ("_ms", "_seconds", "seconds_per", "bytes_per",
+                 "latency", "_wait", "p50", "p90", "p99")
+
+
+def _numeric_leaves(obj, prefix=""):
+    """Flatten a bench JSON artifact to {dotted.path: float} rows —
+    the nested shape (host_threads_search, e2e_trials, ...) varies by
+    line, so --compare diffs whatever numeric leaves both sides
+    share rather than hard-coding a schema."""
+    rows = {}
+    if isinstance(obj, dict):
+        for k, v in sorted(obj.items()):
+            rows.update(_numeric_leaves(v, f"{prefix}{k}."))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            rows.update(_numeric_leaves(v, f"{prefix}{i}."))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        rows[prefix[:-1]] = float(obj)
+    return rows
+
+
+def _bench_rows(path):
+    """Rows from a bench artifact: a raw bench line (the JSON one
+    bench.py mode prints), a BENCH_rNN.json wrapper (diffs its
+    "parsed" payload; the cmd/rc/tail envelope is not a metric), or a
+    JSONL file of several such documents merged."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        docs = [json.loads(text)]
+    except ValueError:
+        docs = [json.loads(ln) for ln in text.splitlines()
+                if ln.strip()]
+    rows = {}
+    for doc in docs:
+        if isinstance(doc, dict) and isinstance(doc.get("parsed"),
+                                                dict):
+            doc = doc["parsed"]
+        rows.update(_numeric_leaves(doc))
+    return rows
+
+
+def compare_main():
+    """Regression diff (`python bench.py --compare OLD.json NEW.json`
+    / `make bench-diff`): per-row NEW/OLD ratios with a direction
+    heuristic (_LOWER_BETTER) and a tolerance band; exits 1 when any
+    shared row regressed past tolerance, so CI can gate on a saved
+    BENCH_rNN.json baseline without bespoke parsing."""
+    import argparse
+    import sys
+    ap = argparse.ArgumentParser(
+        prog="bench.py --compare",
+        description="diff two bench JSON artifacts; exit 1 on "
+                    "regression past --tolerance")
+    ap.add_argument("old", help="baseline artifact (JSON or JSONL)")
+    ap.add_argument("new", help="candidate artifact (JSON or JSONL)")
+    ap.add_argument("--tolerance", type=float, default=0.85,
+                    help="allowed NEW/OLD degradation ratio "
+                         "(default 0.85: a rate may drop to 85%% of "
+                         "baseline, a latency may grow to 1/0.85x)")
+    args = ap.parse_args(sys.argv[2:])
+    old, new = _bench_rows(args.old), _bench_rows(args.new)
+    shared = sorted(set(old) & set(new))
+    if not shared:
+        raise SystemExit("bench --compare: no shared numeric rows "
+                         f"between {args.old} and {args.new}")
+    regressions = []
+    print(f"{'row':<48} {'old':>12} {'new':>12} {'ratio':>8}  "
+          f"dir  status")
+    for k in shared:
+        o, n = old[k], new[k]
+        if o == 0:
+            continue  # ratio undefined; zero baselines carry no bar
+        ratio = n / o
+        lower = any(f in k for f in _LOWER_BETTER)
+        ok = (ratio <= 1.0 / args.tolerance) if lower \
+            else (ratio >= args.tolerance)
+        status = "ok" if ok else "REGRESSION"
+        if not ok:
+            regressions.append(k)
+        print(f"{k:<48} {o:>12.4g} {n:>12.4g} {ratio:>8.3f}  "
+              f"{'lo' if lower else 'hi'}   {status}")
+    for label, only in (("old", set(old) - set(new)),
+                        ("new", set(new) - set(old))):
+        for k in sorted(only):
+            print(f"{k:<48} only in {label}")
+    if regressions:
+        print(f"{len(regressions)} regression(s) past tolerance "
+              f"{args.tolerance}: {', '.join(regressions)}")
+        raise SystemExit(1)
+    print(f"no regressions across {len(shared)} shared row(s) at "
+          f"tolerance {args.tolerance}")
 
 
 if __name__ == "__main__":
@@ -1236,6 +1371,8 @@ if __name__ == "__main__":
         serve_latency_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--multihost":
         multihost_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--compare":
+        compare_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--wire":
         wire_sweep_main()
     else:

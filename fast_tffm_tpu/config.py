@@ -169,6 +169,16 @@ class FmConfig:
     # the per-rank streams — the runtime oracle for fmlint R014. Env
     # fallback: FM_PROTOCOL_TRACE=1.
     protocol_trace: bool = False
+    # Step-anatomy join keys (obs/anatomy.py; README "Step anatomy").
+    # On (default), the lockstep/step producers stamp window/step ids
+    # and host-side phase counters into the telemetry stream — near-zero
+    # cost (ids ride spans that trace_spans already gates; the phase
+    # counters are host perf_counter pairs, no device fetch) — and the
+    # chief emits pre-aggregated anatomy/* gauges at barrier flushes so
+    # `fmstat` can render the EFFICIENCY section from the JSONL alone.
+    # `fmtrace --anatomy` needs a trace_spans = true run for the full
+    # clock-aligned critical-path report. Off: no ids, no anatomy/*.
+    anatomy: bool = True
     # Run-health watchdog (obs/health.py; needs metrics_file). > 0:
     # a daemon thread emits a `health: stalled` event and dumps
     # all-thread stacks to <metrics_file>.stacks when no train/predict
@@ -801,6 +811,7 @@ _TRAIN_KEYS = {
     "metrics_flush_steps": int,
     "trace_spans": bool,
     "protocol_trace": bool,
+    "anatomy": bool,
     "watchdog_stall_seconds": float,
     "bad_line_policy": str,
     "max_bad_fraction": float,

@@ -752,6 +752,79 @@ def worker_table(summary: Dict[str, Any]) -> List[str]:
     return rows
 
 
+# The EFFICIENCY section's gauge surface (README "Step anatomy"): the
+# per-process anatomy/* gauges telemetry.anatomy_gauges pre-aggregates
+# at barrier flushes — phase seconds split into local work vs
+# cross-rank coordination waits. The verdict here works from the JSONL
+# alone; the straggler-wait vs transport split needs the trace replay
+# (fmtrace --anatomy).
+ANATOMY_LOCAL_PHASES = (
+    ("input wait", "anatomy/input_wait_seconds"),
+    ("host build", "anatomy/host_build_seconds"),
+    ("h2d", "anatomy/h2d_seconds"),
+    ("dispatch", "anatomy/dispatch_seconds"),
+    ("window fill", "anatomy/window_fill_seconds"),
+    ("d2h fetch", "anatomy/fetch_seconds"),
+)
+ANATOMY_WAIT_PHASES = (
+    ("flags wait", "anatomy/flags_wait_seconds"),
+    ("lockstep allgather", "anatomy/allgather_seconds"),
+)
+
+
+def efficiency_table(summary: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Per-worker efficiency rows from the pre-aggregated anatomy/*
+    gauges: efficiency = the fraction of step wall NOT parked in
+    cross-rank coordination waits (flags allgather + lockstep
+    allgather). None when no process published coordination waits
+    (single-process runs, anatomy off, or pre-anatomy streams) — the
+    section only exists where there is a cluster to explain. The
+    straggler is the rank that waits LEAST: everyone else's wait is
+    time spent waiting for it."""
+    ranks: Dict[Any, Dict[str, Any]] = {}
+    for proc in sorted(summary.get("gauges_by_process") or {}):
+        g = summary["gauges_by_process"][proc]
+        wall = g.get("anatomy/step_wall_seconds")
+        if not wall:
+            continue
+        wait = sum(g.get(key) or 0.0 for _, key in ANATOMY_WAIT_PHASES)
+        if wait <= 0:
+            continue
+        phases = {label: g.get(key) or 0.0
+                  for label, key in (ANATOMY_LOCAL_PHASES
+                                     + ANATOMY_WAIT_PHASES)}
+        ex = g.get("anatomy/examples") or 0.0
+        ranks[proc] = {
+            "wall_seconds": wall,
+            "wait_seconds": wait,
+            "wait_fraction": wait / wall,
+            "efficiency": max(0.0, 1.0 - wait / wall),
+            "examples_per_sec": (ex / wall) if wall else None,
+            "phases": phases,
+        }
+    if not ranks:
+        return None
+    straggler = min(ranks, key=lambda p: ranks[p]["wait_fraction"])
+    wall_tot = sum(r["wall_seconds"] for r in ranks.values())
+    wait_tot = sum(r["wait_seconds"] for r in ranks.values())
+    wait_frac = wait_tot / wall_tot if wall_tot else 0.0
+    local = {label: v
+             for label, v in ranks[straggler]["phases"].items()
+             if label not in dict(ANATOMY_WAIT_PHASES)}
+    dom = max(local, key=local.get) if any(local.values()) else None
+    verdict = (f"collective wait {wait_frac:.0%} of step"
+               + (f"; rank {straggler} is the straggler"
+                  f" (its dominant local phase: {dom})"
+                  if len(ranks) > 1 and dom else ""))
+    return {
+        "ranks": ranks,
+        "straggler_rank": straggler if len(ranks) > 1 else None,
+        "wait_fraction": wait_frac,
+        "efficiency": max(0.0, 1.0 - wait_frac),
+        "verdict": verdict,
+    }
+
+
 def _fmt(v: Any) -> str:
     if v is None:
         return "-"
@@ -908,6 +981,28 @@ def render(summary: Dict[str, Any]) -> str:
                  f"{_fmt(att['serve_published_step'])}"),
         ):
             lines.append(f"    {k:<32} {_fmt(v)}")
+        hh = summary.get("hists") or {}
+        stages = [hh.get(f"serve/{n}_ms") or {}
+                  for n in ("queue_wait", "pad", "device", "reply")]
+        if any(s.get("count") for s in stages):
+            lines.append(
+                f"    {'flush queue/pad/device/reply':<32} "
+                + " / ".join(_fmt(s.get('p50')) for s in stages)
+                + " ms (p50)")
+    eff = efficiency_table(summary)
+    if eff:
+        lines.append("  EFFICIENCY (step anatomy):")
+        for proc, r in eff["ranks"].items():
+            top = sorted(((v / r["wall_seconds"], label)
+                          for label, v in r["phases"].items() if v),
+                         reverse=True)[:3]
+            phases = ", ".join(f"{label} {frac:.0%}"
+                               for frac, label in top)
+            lines.append(
+                f"    p{proc}: efficiency {r['efficiency']:.2f}  "
+                f"wall {r['wall_seconds']:.1f}s  "
+                f"rate {_fmt(r['examples_per_sec'])}/s  [{phases}]")
+        lines.append(f"    {eff['verdict']}")
     worker_rows = worker_table(summary)
     if worker_rows:
         lines.append("  workers (per-process liveness):")

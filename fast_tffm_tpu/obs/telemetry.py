@@ -131,7 +131,8 @@ class RunTelemetry:
     def __init__(self, path: str, meta: Dict[str, Any],
                  flush_steps: int = 0, trace_spans: bool = False,
                  protocol_trace: bool = False,
-                 watchdog_stall_seconds: float = 0.0):
+                 watchdog_stall_seconds: float = 0.0,
+                 anatomy: bool = True):
         self.registry = MetricsRegistry()
         self.sink = JsonlSink(path, meta=meta)
         self.flush_steps = max(0, int(flush_steps))
@@ -143,6 +144,12 @@ class RunTelemetry:
         # Collective-protocol tracing (parallel/liveness.py):
         # guarded_collective reads this through active() the same way.
         self.protocol_trace = bool(protocol_trace)
+        # Step anatomy (obs/anatomy.py; README "Step anatomy"): gates
+        # the window/step join-key stamping at the producers (train,
+        # sharded) and the pre-aggregated anatomy/* phase gauges every
+        # flush derives from host counters below — near-zero cost, and
+        # NEVER a device fetch (pinned by tests/test_anatomy.py).
+        self.anatomy = bool(anatomy)
         # Compute-plane liveness (parallel/liveness.py): the train/
         # predict drivers attach their HeartbeatLease here so every
         # metrics flush carries per-worker liveness gauges (the fmstat
@@ -235,6 +242,11 @@ class RunTelemetry:
             for k, v in rows.items():
                 self.registry.set(k, v)
             snap["gauges"].update(rows)
+        if self.anatomy:
+            rows = anatomy_gauges(snap)
+            for k, v in rows.items():
+                self.registry.set(k, v)
+            snap["gauges"].update(rows)
         self.sink.emit_metrics(step, snap)
 
     def close(self, step: int = -1) -> None:
@@ -302,6 +314,43 @@ class RunTelemetry:
                    else h2d_bytes_logical)
 
 
+# The step-anatomy phase map (README "Step anatomy"): cumulative
+# host-side seconds counters -> per-process anatomy/* gauges. Counters
+# fold across processes at merge time; the SAME numbers re-emitted as
+# gauges stay per-process (gauges_by_process), which is what the fmstat
+# EFFICIENCY section and bench --multihost need to rank stragglers.
+# Everything here is a float already sitting in the snapshot dict —
+# deriving the gauges can never add a device fetch.
+ANATOMY_PHASES = {
+    "anatomy/input_wait_seconds": "train/input_wait_seconds",
+    "anatomy/host_build_seconds": "pipeline/build_seconds",
+    "anatomy/h2d_seconds": "train/h2d_seconds",
+    "anatomy/flags_wait_seconds": "train/step_flags_seconds",
+    "anatomy/dispatch_seconds": "train/dispatch_seconds",
+    "anatomy/window_fill_seconds": "lockstep/window_fill_seconds",
+    "anatomy/allgather_seconds": "lockstep/allgather_seconds",
+    "anatomy/fetch_seconds": "lockstep/fetch_seconds",
+}
+
+
+def anatomy_gauges(snap: Dict[str, Any]) -> Dict[str, float]:
+    """This process's anatomy/* gauge rows for one registry snapshot:
+    the phase-seconds counters above, plus the step wall and example
+    totals the EFFICIENCY math divides by. Phases that never ticked are
+    omitted (a predict run has no train/ rows and vice versa)."""
+    c = snap.get("counters") or {}
+    rows = {g: float(c[src]) for g, src in ANATOMY_PHASES.items()
+            if c.get(src)}
+    h = (snap.get("hists") or {}).get("train/step_seconds")
+    if h and h.get("count"):
+        rows["anatomy/step_wall_seconds"] = float(h["sum"])
+        rows["anatomy/steps"] = float(h["count"])
+    ex = c.get("train/examples", c.get("predict/examples", 0.0))
+    if ex:
+        rows["anatomy/examples"] = float(ex)
+    return rows
+
+
 def resolve_metrics_path(cfg,
                          process_index: Optional[int] = None
                          ) -> Optional[str]:
@@ -343,7 +392,8 @@ def make_telemetry(cfg, kind: str,
         trace_spans=getattr(cfg, "trace_spans", False),
         protocol_trace=getattr(cfg, "protocol_trace", False),
         watchdog_stall_seconds=getattr(cfg, "watchdog_stall_seconds",
-                                       0.0))
+                                       0.0),
+        anatomy=getattr(cfg, "anatomy", True))
 
 
 def batch_payload_bytes(args: Dict[str, Any]) -> int:

@@ -43,6 +43,15 @@ def span(name: str, **fields):
     """Context manager timing one stage into the active run's stream.
 
     ``fields`` (step/epoch/path/...) land verbatim on the span event.
+    Two field names are a cross-rank JOIN CONTRACT, not free-form
+    annotations (obs/anatomy.py; README "Step anatomy"): ``step`` is
+    the global step id and ``wid`` the lockstep window id — every rank
+    stamps the same id onto the spans of the same barrier'd step/window
+    (the collective protocol guarantees the sequences match), so
+    ``fmtrace --anatomy`` can align per-rank clocks on the matched
+    release edges and split a collective wait into straggler-wait vs
+    transport. Producers gate the stamping on ``anatomy_on()``.
+
     Returns a shared no-op when no run is active or the run was not
     created with ``trace_spans`` — the default-off cost at every
     instrumented site is one module-global read."""
@@ -50,6 +59,15 @@ def span(name: str, **fields):
     if tel is None or not getattr(tel, "trace_spans", False):
         return _NULL
     return _Span(tel.sink, name, fields or None)
+
+
+def anatomy_on() -> bool:
+    """Whether the active run wants step-anatomy join keys stamped
+    (the ``anatomy`` config knob, default on). Same cost discipline as
+    ``span()``: one module-global read + one attribute read, so hot
+    producers may call it per window/step."""
+    tel = _telemetry.active()
+    return tel is not None and getattr(tel, "anatomy", False)
 
 
 class _Span:

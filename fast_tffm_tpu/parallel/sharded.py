@@ -355,11 +355,17 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     from fast_tffm_tpu.data.pipeline import empty_batch
     from fast_tffm_tpu.models.fm import batch_args
     from fast_tffm_tpu.obs.telemetry import active
-    from fast_tffm_tpu.obs.trace import span
+    from fast_tffm_tpu.obs.trace import anatomy_on, span
     from fast_tffm_tpu.parallel.liveness import guarded_collective
     tel = active()  # per-worker lockstep telemetry (obs/): each
     # process counts its own rounds/fillers/examples into its own
     # sink shard; fmstat merges the streams keyed by process index
+    anat = anatomy_on()  # stamp window ids as span join keys
+    wid = -1  # lockstep window id: every rank increments it in the
+    # same barrier'd order (the window allgather IS the barrier), so
+    # the same wid names the same window on every rank — the join key
+    # fmtrace --anatomy aligns per-rank clocks on (obs/anatomy.py)
+    wid_prev = -1  # the window whose deferred scores _drain fetches
     n_real = 0
     filler = None
     filler_gargs = None  # device assembly of the all-padding batch is
@@ -368,7 +374,7 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     pending_prev: list = []  # previous window's dispatched scores,
     # fetched AFTER the next window is dispatched (see _drain below)
 
-    def _drain(pending):
+    def _drain(pending, fetch_wid=-1):
         """Window-deferred bulk fetch: every queued score vector of a
         PREVIOUS window materializes host-side here, after the next
         window's programs were already dispatched — so the D2H drain
@@ -381,20 +387,28 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         mid-window) blocks exactly like the dispatch would."""
         if not pending:
             return []
-        with span("lockstep/score_fetch", batches=len(pending)):
+        ids = {"wid": fetch_wid} if (anat and fetch_wid >= 0) else {}
+        t_fetch = _time.perf_counter()
+        with span("lockstep/score_fetch", batches=len(pending), **ids):
             # collective=False: this is a LOCAL device wait (it runs
             # only when this rank's pending window is non-empty, a
             # per-rank count) — it rides the guard for the deadline,
             # not the protocol trace.
-            return guarded_collective(
+            out = guarded_collective(
                 lambda: [(batch, local_rows(score))
                          for batch, score in pending],
                 label="lockstep/score_fetch", collective=False)
+        if tel is not None:
+            tel.count("lockstep/fetch_seconds",
+                      _time.perf_counter() - t_fetch)
+        return out
 
     while True:
         window = []
+        wid += 1
+        ids = {"wid": wid} if anat else {}
         t_fill = _time.perf_counter()
-        with span("lockstep/window_fill"):
+        with span("lockstep/window_fill", **ids):
             while len(window) < LOCKSTEP_WINDOW:
                 if max_batches and n_real + len(window) >= max_batches:
                     break
@@ -407,7 +421,8 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         # timeline; the deadline guard (parallel/liveness.py) bounds
         # the wait — a dead peer raises WorkerLostError naming it
         # instead of parking the cluster forever.
-        with span("lockstep/allgather", window=len(window)):
+        t_ag = _time.perf_counter()
+        with span("lockstep/allgather", window=len(window), **ids):
             flags = guarded_collective(
                 multihost_utils.process_allgather,
                 np.asarray([len(window),
@@ -415,6 +430,9 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
                             else 0]),
                 label="lockstep/window_fill")
         flags = np.asarray(flags).reshape(-1, 2)
+        if tel is not None:
+            tel.count("lockstep/allgather_seconds",
+                      _time.perf_counter() - t_ag)
         if flags[:, 1].any():
             # Coordinated preemption: every process computed the SAME
             # gathered flags, so all return here together — no program
@@ -422,7 +440,7 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
             # The previous window's deferred scores drain first (local
             # device_get, no collective): they completed, so they are
             # yielded, not re-done after resume.
-            for batch, local in _drain(pending_prev):
+            for batch, local in _drain(pending_prev, wid_prev):
                 yield batch, local
             if tel is not None:
                 tel.count("lockstep/preempted_windows")
@@ -445,33 +463,40 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         if rounds == 0:
             # Every process ran dry in the same round: drain the last
             # deferred window and end the sweep.
-            for batch, local in _drain(pending_prev):
+            for batch, local in _drain(pending_prev, wid_prev):
                 yield batch, local
             return
         pending = []
-        for i in range(rounds):
-            if i < len(window):
-                batch = window[i]
-                args = batch_args(batch)
-                args.pop("labels"), args.pop("weights")
-                gargs = global_batch(mesh, len(batch.uniq_ids), **args)
-            else:
-                if filler_gargs is None:
-                    filler = empty_batch(cfg, uniq_bucket=uniq_bucket)
-                    args = batch_args(filler)
+        t_disp = _time.perf_counter()
+        with span("lockstep/score_dispatch", batches=rounds, **ids):
+            for i in range(rounds):
+                if i < len(window):
+                    batch = window[i]
+                    args = batch_args(batch)
                     args.pop("labels"), args.pop("weights")
-                    filler_gargs = global_batch(
-                        mesh, len(filler.uniq_ids), **args)
-                gargs = filler_gargs
-            # Collective program dispatch under the deadline guard: a
-            # dead peer parks the dispatch inside the program's own
-            # collectives, out of reach of the host-allgather guard
-            # above.
-            score = guarded_collective(score_fn, table,
-                                       label="lockstep/score_dispatch",
-                                       **gargs)
-            if i < len(window):
-                pending.append((batch, score))
+                    gargs = global_batch(mesh, len(batch.uniq_ids),
+                                         **args)
+                else:
+                    if filler_gargs is None:
+                        filler = empty_batch(cfg,
+                                             uniq_bucket=uniq_bucket)
+                        args = batch_args(filler)
+                        args.pop("labels"), args.pop("weights")
+                        filler_gargs = global_batch(
+                            mesh, len(filler.uniq_ids), **args)
+                    gargs = filler_gargs
+                # Collective program dispatch under the deadline
+                # guard: a dead peer parks the dispatch inside the
+                # program's own collectives, out of reach of the
+                # host-allgather guard above.
+                score = guarded_collective(
+                    score_fn, table,
+                    label="lockstep/score_dispatch", **gargs)
+                if i < len(window):
+                    pending.append((batch, score))
+        if tel is not None:
+            tel.count("lockstep/dispatch_seconds",
+                      _time.perf_counter() - t_disp)
         n_real += len(window)
         if tel is not None:
             tel.count("lockstep/examples",
@@ -480,8 +505,9 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         # in flight, so its compute overlaps this D2H); this window's
         # scores stay queued on device until the next round — at most
         # one extra window of [B_global] f32 vectors held in HBM.
-        fetched = _drain(pending_prev)
+        fetched = _drain(pending_prev, wid_prev)
         pending_prev = pending
+        wid_prev = wid
         for batch, local in fetched:
             # This process's rows of the global [B_global] score vector
             # are exactly its local batch (global_batch concatenates

@@ -462,6 +462,16 @@ class ScorerServer:
         reg = self._reg
         try:
             import jax
+            # Per-flush latency decomposition (fmstat SERVING "flush
+            # queue/pad/device/reply" row; GET /metrics histograms).
+            # The stage clocks ride timestamps the flush path already
+            # takes or bracket work it already does — no new device
+            # fetches; the one blocking fetch stays the score_batch
+            # device_get below.
+            t0 = time.perf_counter()
+            reg.observe("serve/queue_wait_ms",
+                        (t0 - min(p.t0 for p in window)) * 1000.0,
+                        bounds=LATENCY_BUCKETS_MS)
             block = _concat_blocks([p.block for p in window])
             rung = next(b for b in self._b_ladder if b >= n)
             with self._table_lock:
@@ -469,13 +479,21 @@ class ScorerServer:
                 step = self._served_step
                 vmap = self._vocab_map
             with span("serve/flush", examples=n, rung=rung):
+                t_pad = time.perf_counter()
                 batch = make_device_batch(block, self._build_cfg,
                                           batch_size=rung,
                                           raw_ids=True)
                 if vmap is not None:
                     batch = vmap.remap(batch)
+                t_dev = time.perf_counter()
+                reg.observe("serve/pad_ms", (t_dev - t_pad) * 1000.0,
+                            bounds=LATENCY_BUCKETS_MS)
                 raw = np.asarray(jax.device_get(
                     self._scorer.score_batch(table, batch)))[:n]
+                reg.observe("serve/device_ms",
+                            (time.perf_counter() - t_dev) * 1000.0,
+                            bounds=LATENCY_BUCKETS_MS)
+            t_reply = time.perf_counter()
             vals = (sigmoid(raw) if self.cfg.loss_type == "logistic"
                     else raw.astype(np.float64))
             reg.count("serve/flushes")
@@ -493,6 +511,9 @@ class ScorerServer:
                 reg.observe("serve/request_latency_ms",
                             (done - p.t0) * 1000.0,
                             bounds=LATENCY_BUCKETS_MS)
+            reg.observe("serve/reply_ms",
+                        (time.perf_counter() - t_reply) * 1000.0,
+                        bounds=LATENCY_BUCKETS_MS)
         except BaseException as e:  # noqa: BLE001 - per-window failure
             # surface: the window's callers get the error, the server
             # keeps serving (the next window may be fine).

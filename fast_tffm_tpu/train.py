@@ -821,31 +821,48 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             tel.set("wire/packed", 1.0 if wire_spec.packed else 0.0)
             tel.set("wire/narrow", 1.0 if wire_spec.narrow else 0.0)
 
-        def _wire_place(batch):
+        # Step-anatomy join keys (obs/anatomy.py; README "Step
+        # anatomy"): when on, the loops stamp the step id into the
+        # h2d/step/flags spans (so fmtrace --anatomy can join phases
+        # across ranks) and feed the host-side phase-seconds counters
+        # the anatomy/* gauges aggregate at barrier flushes.
+        anat = tel is not None and getattr(tel, "anatomy", False)
+
+        def _wire_place(batch, step=0):
             """Encode one batch and place its arrays for dispatch —
             the ONE body both run-mode loops share (a drifted copy
             here is how the two modes' h2d accounting or placement
             would silently diverge). h2d_bytes = wb.wire_bytes sizes
             the arrays ACTUALLY shipped; the padded-layout size rides
-            on wb.logical_bytes for the savings counter."""
+            on wb.logical_bytes for the savings counter. ``step``
+            (anatomy on) rides the h2d span as the cross-rank join
+            key; the placed arms also feed the train/h2d_seconds
+            anatomy phase counter."""
             wb = wire_enc.encode_train(batch)
+            ids = {"step": step} if (anat and step) else {}
+            t_h2d = time.perf_counter()
+            placed = True
             if multi_process:
                 # The global-array assembly ships every shard's bytes.
-                with span("train/h2d", bytes=wb.wire_bytes):
+                with span("train/h2d", bytes=wb.wire_bytes, **ids):
                     args = global_batch(mesh, len(batch.uniq_ids),
                                         **wb.args)
             elif mesh is not None:
-                with span("train/h2d", bytes=wb.wire_bytes):
+                with span("train/h2d", bytes=wb.wire_bytes, **ids):
                     args = shard_batch(mesh, **wb.args)
             elif wire_stage:
                 # Depth-2 double buffer: the explicit async put rides
                 # the copy stream while the PREVIOUS step is still
                 # executing, instead of serializing at the head of
                 # this step's dispatch.
-                with span("train/h2d", bytes=wb.wire_bytes):
+                with span("train/h2d", bytes=wb.wire_bytes, **ids):
                     args = wire_enc.device_put(wb)
             else:
                 args = wb.args
+                placed = False
+            if placed and tel is not None:
+                tel.count("train/h2d_seconds",
+                          time.perf_counter() - t_h2d)
             return wb, args
 
         def _wire_step(wb, args, table, acc):
@@ -856,12 +873,20 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 # cluster its dispatch blocks inside the program's
                 # collectives exactly like a host allgather (pinned by
                 # the hang-worker chaos stack dumps), so it runs under
-                # the same deadline guard.
+                # the same deadline guard. The dispatch wait is an
+                # anatomy phase: jax dispatch is async (returns at
+                # enqueue), so time spent HERE is queue backpressure —
+                # the previous program still executing somewhere.
                 from fast_tffm_tpu.parallel.liveness import (
                     guarded_collective)
-                return guarded_collective(
+                t_disp = time.perf_counter()
+                out = guarded_collective(
                     step_fn, table, acc,
                     label="train/step_dispatch", **args)
+                if tel is not None:
+                    tel.count("train/dispatch_seconds",
+                              time.perf_counter() - t_disp)
+                return out
             if wb.packed:
                 return packed_step(wb.L, table, acc, **args)
             return step_fn(table, acc, **args)
@@ -1409,7 +1434,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # the barrier evicted/reset/reassigned (one int
                     # compare when nothing moved).
                     batch = vocab.ensure_current(batch)
-                wb, args = _wire_place(batch)
+                wb, args = _wire_place(batch, global_step + 1)
                 h2d_bytes = wb.wire_bytes
                 with span("train/step", step=global_step + 1):
                     table, acc, loss, _ = _wire_step(wb, args,
@@ -1529,11 +1554,28 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                         has = b not in (streamlib.IDLE, streamlib.DONE)
                         done = b is streamlib.DONE
                         pub_due = publish_due()
-                        flags = np.asarray(guarded_collective(
-                            multihost_utils.process_allgather,
-                            np.asarray([has, bool(preempted), done,
-                                        pub_due]),
-                            label="stream/step_flags")).reshape(-1, 4)
+                        # The flags allgather is the stream loop's
+                        # rank barrier: time parked here is waiting
+                        # for the slowest peer (anatomy flags-wait
+                        # phase; the span's step id is the cross-rank
+                        # join key).
+                        ids = ({"step": global_step + 1} if anat
+                               else {})
+                        # fmlint: disable=R003 -- feeds the train/
+                        # step_flags_seconds anatomy counter
+                        t_fl = time.perf_counter()
+                        with span("stream/step_flags", **ids):
+                            flags = np.asarray(guarded_collective(
+                                multihost_utils.process_allgather,
+                                np.asarray([has, bool(preempted),
+                                            done, pub_due]),
+                                label="stream/step_flags"
+                                )).reshape(-1, 4)
+                        if tel is not None:
+                            # fmlint: disable=R003 -- closes the
+                            # flags-wait sample
+                            tel.count("train/step_flags_seconds",
+                                      time.perf_counter() - t_fl)
                         if bool(flags[:, 1].any()):
                             emit_preempted()
                             break
@@ -1659,10 +1701,27 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     from jax.experimental import multihost_utils
                     from fast_tffm_tpu.parallel.liveness import (
                         guarded_collective)
-                    flags = guarded_collective(
-                        multihost_utils.process_allgather,
-                        np.asarray([batch is None, bool(preempted)]),
-                        label="train/step_flags")
+                    # The epoch loop's rank barrier (anatomy flags-
+                    # wait phase; span step id = cross-rank join key).
+                    # On CPU+gloo this wait also absorbs the PREVIOUS
+                    # step's still-executing program — allgather
+                    # blocks behind queued device work — which is
+                    # exactly what the anatomy report names.
+                    ids = {"step": global_step + 1} if anat else {}
+                    # fmlint: disable=R003 -- feeds the train/
+                    # step_flags_seconds anatomy counter
+                    t_fl = time.perf_counter()
+                    with span("train/step_flags", **ids):
+                        flags = guarded_collective(
+                            multihost_utils.process_allgather,
+                            np.asarray([batch is None,
+                                        bool(preempted)]),
+                            label="train/step_flags")
+                    if tel is not None:
+                        # fmlint: disable=R003 -- closes the flags-
+                        # wait sample
+                        tel.count("train/step_flags_seconds",
+                                  time.perf_counter() - t_fl)
                     if bool(flags[..., 1].any()):
                         stopping = True
                         logger.info(
@@ -1709,7 +1768,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                     # this is the one-integer-compare insurance the
                     # stream loop actually needs (see step_once).
                     batch = vocab.ensure_current(batch)
-                wb, args = _wire_place(batch)
+                wb, args = _wire_place(batch, global_step + 1)
                 h2d_bytes = wb.wire_bytes
                 # trace_span only while a profiler window is open: a
                 # per-step TraceAnnotation costs ~14x throughput on this

@@ -430,11 +430,15 @@ def test_r017_mutant_fetch_under_table_lock():
                 step = self._served_step
                 vmap = self._vocab_map
             with span("serve/flush", examples=n, rung=rung):
+                t_pad = time.perf_counter()
                 batch = make_device_batch(block, self._build_cfg,
                                           batch_size=rung,
                                           raw_ids=True)
                 if vmap is not None:
                     batch = vmap.remap(batch)
+                t_dev = time.perf_counter()
+                reg.observe("serve/pad_ms", (t_dev - t_pad) * 1000.0,
+                            bounds=LATENCY_BUCKETS_MS)
                 raw = np.asarray(jax.device_get(
                     self._scorer.score_batch(table, batch)))[:n]"""
     mutant = """\

@@ -259,8 +259,12 @@ def test_stalled_train_run_emits_health_and_stacks(tmp_path, rng,
     dump = open(stacks).read()
     assert "Current thread" in dump  # faulthandler's all-thread format
     # the run RECOVERED after the sleep and finished; fmstat still
-    # surfaces the episode
-    assert [h["status"] for h in health].count("recovered") == 1
+    # surfaces the episode.  A slow first jit compile can trip an extra
+    # stalled/recovered pair at last_step == -1 before any step runs, so
+    # pin the injected mid-run episode rather than the episode count.
+    assert [h["status"] for h in health].count("recovered") >= 1
+    mid_run = [h for h in stalls if h.get("last_step", -1) >= 0]
+    assert mid_run, f"no mid-run stall episode in {health}"
     from tools.fmstat import main as fmstat_main
     assert fmstat_main([path]) == 0
     assert "health: STALLED" in capsys.readouterr().out
@@ -362,9 +366,10 @@ def test_fmtrace_roundtrip_multiworker(tmp_path):
     assert {e["pid"] for e in pn} == {0, 1}
     assert any(e["ph"] == "M" and e["name"] == "thread_name"
                for e in evs)
-    # gauges became counter tracks
+    # gauges became counter tracks, unit-labeled (PR 17), with their
+    # last value re-emitted at run_end so short runs render
     cs = [e for e in evs if e["ph"] == "C"
-          and e["name"] == "train/examples_per_sec_window"]
+          and e["name"] == "train/examples_per_sec_window [1/s]"]
     assert {e["args"]["value"] for e in cs} == {1000.0, 1001.0}
     # run_start/run_end instants frame each track
     assert any(e["ph"] == "i" and e["name"] == "run_end" for e in evs)

@@ -2,12 +2,20 @@
 
     python -m tools.fmtrace <metrics.jsonl> [more shards...] [-o out.json]
     python -m tools.fmtrace --collectives <metrics.jsonl> <metrics>.p*
+    python -m tools.fmtrace --anatomy [--json] <metrics.jsonl> <metrics>.p*
 
 The second form skips the Perfetto export and diffs the per-rank
 collective sequences a ``protocol_trace = true`` run records (exit 1
 naming the first mismatching rank/position/label) — the runtime oracle
 for fmlint's R014 protocol checker, and the first diagnostic for a
 hung multi-host cluster.
+
+The third form renders the cross-rank step-anatomy report
+(obs/anatomy.py; README "Step anatomy"): clock-aligned phase accounts,
+straggler-wait vs transport split of every matched barrier, per-worker
+efficiency recomputed from the phases, and a named verdict. Needs a
+``trace_spans = true`` run (all shards together); ``--json`` emits the
+machine-readable report instead of the table.
 
 Converts the obs/ telemetry stream (spans, gauges, scalars, health and
 crash events) into Chrome trace-event JSON loadable in ui.perfetto.dev
@@ -53,6 +61,28 @@ def _us(t: float) -> float:
     return t * 1e6
 
 
+# Counter-track unit suffixes, checked in order against the metric
+# name: Perfetto counter tracks have no unit axis, so the unit rides
+# in the track name (a bytes track next to a seconds track is
+# otherwise two unlabeled squiggles).
+_UNIT_RULES = (
+    ("_ms", "ms"),
+    ("seconds", "s"),
+    ("bytes", "B"),
+    ("per_sec", "1/s"),
+    ("examples", "examples"),
+)
+
+
+def counter_track(name: str) -> str:
+    """The Perfetto track name for a counter/gauge: the metric name
+    plus its unit in brackets when the name declares one."""
+    for frag, unit in _UNIT_RULES:
+        if frag in name:
+            return f"{name} [{unit}]"
+    return name
+
+
 class _TidMap:
     """Stable small ints per (pid, thread-name), plus the metadata
     events that name the rows in the UI. tid 0 is reserved for the
@@ -80,6 +110,12 @@ def to_trace_events(paths: Sequence[str]) -> List[Dict[str, Any]]:
     out: List[Dict[str, Any]] = []
     tids = _TidMap()
     named_pids = set()
+    # Last value per (pid -> counter track): re-emitted at run_end so
+    # a short run's single-sample counters still render as a line
+    # (Perfetto draws nothing for a one-point counter track).
+    last_counters: Dict[int, Dict[str, float]] = {}
+    # protocol_trace collective events, for cross-rank flow arrows.
+    collectives: List[Dict[str, Any]] = []
     for path in paths:
         pid = 0  # until a run_start announces the real process index
         for rec in read_events(path):
@@ -110,10 +146,12 @@ def to_trace_events(paths: Sequence[str]) -> List[Dict[str, Any]]:
             elif ev == "metrics":
                 for name, v in (rec.get("gauges") or {}).items():
                     if isinstance(v, (int, float)) and math.isfinite(v):
+                        track = counter_track(name)
                         out.append({
-                            "ph": "C", "name": name, "pid": pid,
+                            "ph": "C", "name": track, "pid": pid,
                             "tid": 0, "ts": _us(t),
                             "args": {"value": v}})
+                        last_counters.setdefault(pid, {})[track] = v
             elif ev == "scalar":
                 val = rec.get("value")
                 if isinstance(val, (int, float)) and math.isfinite(val):
@@ -121,10 +159,17 @@ def to_trace_events(paths: Sequence[str]) -> List[Dict[str, Any]]:
                     # "C" event is its own plotted series, so a step
                     # number here would stack a huge second series
                     # that flattens the one being shown.
+                    track = counter_track(rec.get("name", "scalar"))
                     out.append({
-                        "ph": "C", "name": rec.get("name", "scalar"),
+                        "ph": "C", "name": track,
                         "pid": pid, "tid": 0, "ts": _us(t),
                         "args": {"value": val}})
+                    last_counters.setdefault(pid, {})[track] = val
+            elif ev == "collective":
+                collectives.append({
+                    "pid": pid, "t": t,
+                    "seq": rec.get("seq", 0),
+                    "label": str(rec.get("label", "?"))})
             elif ev == "health":
                 out.append(_instant(
                     f"health: {rec.get('status', '?')}", t, pid,
@@ -135,10 +180,61 @@ def to_trace_events(paths: Sequence[str]) -> List[Dict[str, Any]]:
                     "crash: " + str(rec.get("error", "?"))[:120], t, pid,
                     args={"step": rec.get("step")}))
             elif ev == "run_end":
+                # Close every counter track with its last value at the
+                # run's end so short runs draw a visible line instead
+                # of a single invisible point.
+                for track, v in sorted(
+                        (last_counters.get(pid) or {}).items()):
+                    out.append({
+                        "ph": "C", "name": track, "pid": pid,
+                        "tid": 0, "ts": _us(t),
+                        "args": {"value": v}})
                 out.append(_instant("run_end", t, pid))
+    out.extend(_collective_flows(collectives, tids))
     out.extend(tids.meta)
     # Stable paint order: metadata first, then by timestamp.
     out.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0.0)))
+    return out
+
+
+def _collective_flows(collectives: List[Dict[str, Any]],
+                      tids: "_TidMap") -> List[Dict[str, Any]]:
+    """Cross-rank flow arrows between matched collective events: the
+    same seq on every rank IS the same collective (the protocol-trace
+    invariant fmtrace --collectives checks), so each seq becomes one
+    Perfetto flow threading every rank's marker slice. The arrows make
+    a lagging rank visually obvious: its slice sits to the right and
+    every arrow into it slopes."""
+    out: List[Dict[str, Any]] = []
+    by_seq: Dict[Any, List[Dict[str, Any]]] = {}
+    for c in collectives:
+        by_seq.setdefault(c["seq"], []).append(c)
+    for seq, group in sorted(by_seq.items(),
+                             key=lambda kv: str(kv[0])):
+        group.sort(key=lambda c: c["t"])
+        for c in group:
+            # A tiny slice per rank (flows bind to slices, not
+            # instants), on a dedicated per-process row.
+            tid = tids.tid(c["pid"], "collectives")
+            out.append({
+                "ph": "X", "cat": "collective",
+                "name": c["label"], "pid": c["pid"], "tid": tid,
+                "ts": _us(c["t"]), "dur": 50.0,
+                "args": {"seq": seq}})
+        if len(group) < 2:
+            continue
+        for i, c in enumerate(group):
+            ph = ("s" if i == 0
+                  else "f" if i == len(group) - 1 else "t")
+            ev = {
+                "ph": ph, "cat": "collective",
+                "name": c["label"], "id": int(seq),
+                "pid": c["pid"],
+                "tid": tids.tid(c["pid"], "collectives"),
+                "ts": _us(c["t"]) + 1.0}
+            if ph == "f":
+                ev["bp"] = "e"
+            out.append(ev)
     return out
 
 
@@ -226,9 +322,31 @@ def main(argv=None) -> int:
                     help="diff the per-rank collective sequences "
                          "(protocol_trace runs) instead of exporting "
                          "a Perfetto trace; exit 1 on divergence")
+    ap.add_argument("--anatomy", action="store_true",
+                    help="render the cross-rank step-anatomy report "
+                         "(obs/anatomy.py) from a trace_spans run's "
+                         "shards instead of exporting a trace")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="with --anatomy: emit the machine-readable "
+                         "report instead of the table")
+    ap.add_argument("--baseline-eps", type=float, default=None,
+                    help="with --anatomy: a single-process "
+                         "examples/sec rate (e.g. bench.py "
+                         "--multihost's 1-worker leg); unlocks "
+                         "absolute per-worker efficiency = useful "
+                         "compute time / wall, which also counts "
+                         "stalls inside the dispatched program")
     args = ap.parse_args(argv)
     # Shared glob + fail-loudly-on-unreadable policy (tools/__init__).
     files = expand_stream_args(args.files)
+    if args.anatomy:
+        from fast_tffm_tpu.obs import anatomy
+        rep = anatomy.report(files, baseline_eps=args.baseline_eps)
+        if args.as_json:
+            print(json.dumps(rep, indent=1, sort_keys=True))
+        else:
+            print(anatomy.render(rep))
+        return 1 if "error" in rep else 0
     if args.collectives:
         return diff_collectives(collective_sequences(files))
     out_path = args.out or files[0] + ".trace.json"
