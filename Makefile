@@ -15,6 +15,10 @@
 #   make bench-wire standalone wire-format sweep: padded-wide vs
 #                   packed-wide vs packed-narrow on h2d_only and e2e,
 #                   with bytes/example on the wire
+#   make bench-memory  device-memory ledger profile: bytes/row,
+#                   planner-vs-ledger and peak-vs-model ratios off a
+#                   real train run, serve reload spike off a real
+#                   hot reload
 #   make lint       fmlint whole-program pass (R000-R017) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py;
 #                   writes the machine-readable findings artifact to
@@ -74,6 +78,9 @@ bench-vocab: $(SO)
 bench-wire: $(SO)
 	python bench.py --wire
 
+bench-memory: $(SO)
+	JAX_PLATFORMS=cpu python bench.py --memory
+
 lint:
 	python -m tools.fmlint --profile --json-out .fmlint_cache/findings.json
 
@@ -109,4 +116,4 @@ anatomy:
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict bench-vocab bench-wire bench-multihost bench-diff anatomy lint chaos stream-soak serve serve-soak slo-soak grow-soak clean
+.PHONY: all test bench bench-host bench-predict bench-vocab bench-wire bench-memory bench-multihost bench-diff anatomy lint chaos stream-soak serve serve-soak slo-soak grow-soak clean

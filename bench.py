@@ -396,6 +396,120 @@ def wire_sweep_main():
     }))
 
 
+def run_memory_profile(tmp):
+    """The bytes-axis bench rows (README "Memory observability";
+    `python bench.py --memory` / `make bench-memory`): bytes/row of
+    the resident state, the planner-vs-ledger agreement and the
+    peak-vs-model ratio measured off a REAL train run's mem/* gauges,
+    and the serve reload spike (the old+new transient) off a real
+    hot reload — the numbers the capacity frontiers (sharded / f16
+    tables) will move."""
+    from fast_tffm_tpu.checkpoint import CheckpointState
+    from fast_tffm_tpu.config import FmConfig
+    from fast_tffm_tpu.obs.attribution import summarize
+    from fast_tffm_tpu.obs.memory import LEDGER, plan, table_bytes
+    from fast_tffm_tpu.serve import ScorerServer
+    from fast_tffm_tpu.train import train
+    wd = os.path.join(tmp, "memory")
+    os.makedirs(wd, exist_ok=True)
+    path = os.path.join(wd, "train.txt")
+    with open(path, "w") as fh:
+        fh.write("\n".join(synth_lines(3072, 1 << 15)) + "\n")
+    LEDGER.reset()
+    # max_features 64 keeps the planner's wire ceiling honest for the
+    # 39-feature synth lines (cap >= real nnz, same order as the
+    # padded rectangle) — the agreement row measures planner-vs-ledger
+    # drift, not ceiling slack from an uncapped default.
+    cfg = FmConfig(vocabulary_size=1 << 15, factor_num=8,
+                   batch_size=256, epoch_num=1, train_files=(path,),
+                   max_features_per_example=64,
+                   model_file=os.path.join(wd, "fm"),
+                   metrics_file=os.path.join(wd, "metrics.jsonl"),
+                   metrics_flush_steps=4)
+    train(cfg)
+    g = summarize([cfg.metrics_file]).get("gauges", {})
+    model = table_bytes(cfg)
+    p = plan(cfg, "train")
+    # The stream's LAST mem/live_bytes is post-release (0); the
+    # resident set the planner predicts is the mid-run maximum.
+    live = 0.0
+    with open(cfg.metrics_file) as fh:
+        for line in fh:
+            ev = json.loads(line)
+            if ev.get("event") == "metrics":
+                live = max(live,
+                           ev.get("gauges", {}).get("mem/live_bytes",
+                                                    0.0))
+    peak = g.get("mem/peak_bytes") or 0.0
+    out = {
+        "model_bytes": model,
+        "bytes_per_row": round(model / cfg.num_rows, 1),
+        "ledger_live_bytes": int(live),
+        "ledger_peak_bytes": int(peak),
+        "plan_total_bytes": p["total_bytes"],
+        # Planner prediction over the measured live ledger: the wire
+        # row is a from-config ceiling, so slightly > 1.0 is expected;
+        # far from 1.0 means planner and producers disagree.
+        "plan_vs_ledger_x": (round(p["total_bytes"] / live, 3)
+                             if live else None),
+        # Peak over one dense model copy: table + optimizer state
+        # (+ wire) — the "how much bigger than the .npz is the run"
+        # multiplier capacity planning actually needs.
+        "peak_vs_model_x": round(peak / model, 3) if model else None,
+    }
+    # Serve reload spike: a real server, a real hot reload — the gauge
+    # carries the old+new transient the reload held until the swap.
+    LEDGER.reset()
+    swd = os.path.join(wd, "serve")
+    os.makedirs(swd, exist_ok=True)
+    scfg = FmConfig(vocabulary_size=1 << 15, factor_num=8,
+                    max_features_per_example=48, bucket_ladder=(48,),
+                    model_file=os.path.join(swd, "fm"),
+                    serve_max_batch=64, serve_poll_seconds=60.0)
+    rng = np.random.default_rng(0)
+    table = rng.standard_normal(
+        (scfg.ckpt_rows, scfg.row_dim)).astype(np.float32) * 0.01
+    ckpt = CheckpointState(scfg.model_file)
+    ckpt.save(1, table, np.full_like(table, 0.1),
+              vocabulary_size=scfg.vocabulary_size, wait=True)
+    ckpt.save(2, table, np.full_like(table, 0.1),
+              vocabulary_size=scfg.vocabulary_size, wait=True)
+    ckpt.publish_step(1)
+    ckpt.close()
+    del table
+    server = ScorerServer(scfg, watch=False)
+    try:
+        if not server.reload_step(2):
+            raise RuntimeError("bench --memory: hot reload of step 2 "
+                               "failed")
+        sg = server._reg.snapshot()["gauges"]
+    finally:
+        server.close()
+    spike = sg.get("serve/reload_peak_bytes") or 0.0
+    serve_model = table_bytes(scfg)
+    out["serve_reload_spike_bytes"] = int(spike)
+    out["serve_reload_spike_vs_model_x"] = (
+        round(spike / serve_model, 3) if serve_model else None)
+    LEDGER.reset()
+    return out
+
+
+def memory_main():
+    """Standalone device-memory profile (`python bench.py --memory` /
+    `make bench-memory`): one JSON line with the ledger/planner/reload
+    rows."""
+    import tempfile
+    _enable_compile_cache()
+    with tempfile.TemporaryDirectory() as tmp:
+        res = run_memory_profile(tmp)
+    print(json.dumps({
+        "metric": "mem_peak_vs_model_x",
+        "value": res["peak_vs_model_x"],
+        "unit": "peak ledger bytes over one dense model copy",
+        "memory": res,
+    }))
+
+
 def _enable_compile_cache():
     """Share the CLI's persistent XLA compile cache so the isolated
     line subprocesses (and repeat bench invocations) skip recompiles.
@@ -1375,5 +1489,7 @@ if __name__ == "__main__":
         compare_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--wire":
         wire_sweep_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--memory":
+        memory_main()
     else:
         main()

@@ -184,6 +184,14 @@ class FmConfig:
     # all-thread stacks to <metrics_file>.stacks when no train/predict
     # step lands for this many seconds. 0 (default) = off.
     watchdog_stall_seconds: float = 0.0
+    # HBM pressure threshold (obs/memory.py; README "Memory
+    # observability"; needs metrics_file). > 0: a metrics flush whose
+    # ledger live bytes cross this fraction of the device capacity
+    # emits one `health: hbm_pressure` event per episode (re-armed
+    # when live drops back below) — the early-warning signal before a
+    # RESOURCE_EXHAUSTED. Inert when the backend reports no capacity
+    # (CPU container). 0 (default) = off.
+    mem_pressure_fraction: float = 0.0
     # Data-plane fault tolerance (README "Fault tolerance").
     # What a malformed input line does to the run (data/badlines.py):
     # "error" (default) aborts on the first bad line — the historical
@@ -502,6 +510,10 @@ class FmConfig:
             raise ValueError(
                 f"watchdog_stall_seconds must be >= 0 (0 = watchdog "
                 f"off), got {self.watchdog_stall_seconds}")
+        if not 0.0 <= self.mem_pressure_fraction <= 1.0:
+            raise ValueError(
+                f"mem_pressure_fraction must be in [0, 1] (0 = off), "
+                f"got {self.mem_pressure_fraction}")
         if self.bad_line_policy not in ("error", "skip", "quarantine"):
             raise ValueError(
                 f"unknown bad_line_policy {self.bad_line_policy!r} "
@@ -813,6 +825,7 @@ _TRAIN_KEYS = {
     "protocol_trace": bool,
     "anatomy": bool,
     "watchdog_stall_seconds": float,
+    "mem_pressure_fraction": float,
     "bad_line_policy": str,
     "max_bad_fraction": float,
     "io_retries": int,

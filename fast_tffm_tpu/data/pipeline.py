@@ -2005,6 +2005,7 @@ def prefetch(iterator: Iterator[DeviceBatch], depth: int = 2,
     # Named thread: span events from the pipeline carry the thread name
     # as their Perfetto track (tools/fmtrace).
     threading.Thread(target=worker, name="prefetch", daemon=True).start()
+    ledgered = False
     try:
         while True:
             item = q.get()
@@ -2012,9 +2013,28 @@ def prefetch(iterator: Iterator[DeviceBatch], depth: int = 2,
                 if errbox:
                     raise errbox[0]
                 return
+            if not ledgered:
+                # Ledger (obs/memory.py): the prefetch window's
+                # standing footprint — queue depth + the in-hand batch,
+                # sized from the first batch (bucketed shapes keep
+                # later ones comparable). Host-resident numpy until the
+                # wire layer places it (host=True: gauged, excluded
+                # from the device live total). Once, not per batch —
+                # this is the hottest host loop in the tree.
+                ledgered = True
+                nb = 0
+                for v in getattr(item, "__dict__", {}).values():
+                    nb += getattr(v, "nbytes", 0)
+                if nb:
+                    from fast_tffm_tpu.obs.memory import LEDGER
+                    LEDGER.register("prefetch_batches",
+                                    (max(depth, 1) + 1) * nb,
+                                    host=True)
             yield item
     finally:
         stop.set()
+        from fast_tffm_tpu.obs.memory import LEDGER
+        LEDGER.release("prefetch_batches")
 
 
 def _salvage_block(lines: Sequence[str], cfg: FmConfig,

@@ -489,7 +489,8 @@ class PinnedHostLookup:
         import jax
         import jax.numpy as jnp
 
-        nbytes = self.rows * self.dim * 4
+        from fast_tffm_tpu.obs.memory import table_bytes
+        nbytes = table_bytes(rows=self.rows, dim=self.dim)
         if not self._pinned or nbytes <= self._ALLOC_SLAB_BYTES:
             @functools.partial(jax.jit, out_shardings=self._s_state)
             def full():
@@ -718,11 +719,11 @@ def memory_report() -> dict:
     except OSError:
         pass
     out = {"host_rss_mb": rss, "host_peak_rss_mb": peak}
-    try:
-        import jax
-        stats = jax.local_devices()[0].memory_stats()
-    except Exception:
-        stats = None
+    # Through the one memory seam (obs/memory.py; fmlint R018): same
+    # unmeasured-is-None contract, plus the FM_FAKE_HBM_BYTES test
+    # injection for free.
+    from fast_tffm_tpu.obs.memory import device_memory_stats
+    stats = device_memory_stats()
     def mb(key):  # missing key = UNMEASURED (None), never a fake 0
         if not stats or key not in stats:
             return None

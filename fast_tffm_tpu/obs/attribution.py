@@ -445,7 +445,7 @@ HEALTH_KINDS = frozenset({
     "stalled", "recovered", "nonfinite_loss", "preempted",
     "worker_lost", "elastic_recovered", "ckpt_fallback", "bad_input",
     "collective_slow", "cluster_bringup_failed", "gate_held",
-    "join_refused",
+    "join_refused", "hbm_pressure",
 })
 
 
@@ -607,6 +607,28 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                      "input burst (quarantine sidecar, quality/auc "
                      "timeline) — publishes resume when validation "
                      "recovers"] + notes)}
+    pressures = [h for h in health if h.get("status") == "hbm_pressure"]
+    if pressures:
+        # Ranked below DEGRADED/STALLED/GATE-HELD (the run is making
+        # progress and its quality is fine — it is close to a capacity
+        # wall) and above STALE PUBLISH (a pressured device is about
+        # to become a failing reload/publish; name the cause first).
+        last = pressures[-1]
+        owners = last.get("owners") or {}
+        top = (max(owners.items(), key=lambda kv: kv[1])
+               if owners else None)
+        top_note = (f"; largest owner {top[0]} "
+                    f"({_fmt(top[1] / 2**20)} MB)" if top else "")
+        return {"verdict": f"HBM-PRESSURE (x{len(pressures)})",
+                "detail": "; ".join(
+                    [f"{len(pressures)} pressure episode(s): live "
+                     f"device bytes reached "
+                     f"{_fmt(100 * float(last.get('fraction') or 0))}% "
+                     f"of capacity (threshold "
+                     f"{_fmt(100 * float(last.get('threshold') or 0))}"
+                     f"%){top_note}. Size a fix before the OOM: python "
+                     "-m tools.fmstat capacity <cfg> --what-if "
+                     "vocabulary_size=...,dtype=f16,shards=K"] + notes)}
     stale = stale_publish(summary)
     if stale is not None:
         # Checked BEFORE the unclosed-stream heuristic: a live stream
@@ -825,6 +847,33 @@ def efficiency_table(summary: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     }
 
 
+def memory_table(summary: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Device-memory rows from the mem/* ledger gauges (obs/memory.py;
+    chief view — the ledger is per-process and the flat gauges are
+    process 0's). None for pre-ledger streams — the MEMORY section
+    only exists where a ledger wrote gauges."""
+    g = summary.get("gauges", {})
+    if g.get("mem/live_bytes") is None and g.get("mem/peak_bytes") is None:
+        return None
+    totals = ("mem/live_bytes", "mem/peak_bytes", "mem/capacity_bytes",
+              "mem/host_live_bytes", "mem/device_in_use_bytes")
+    owners = {k[len("mem/"):-len("_bytes")]: v
+              for k, v in g.items()
+              if k.startswith("mem/") and k.endswith("_bytes")
+              and k not in totals}
+    return {
+        "owners": owners,
+        "live_bytes": g.get("mem/live_bytes"),
+        "peak_bytes": g.get("mem/peak_bytes"),
+        "host_live_bytes": g.get("mem/host_live_bytes"),
+        "capacity_bytes": g.get("mem/capacity_bytes"),
+        "utilization_fraction": g.get("mem/utilization_fraction"),
+        "pressure_events":
+            (summary.get("counters") or {}).get("mem/pressure_events"),
+        "reload_peak_bytes": g.get("serve/reload_peak_bytes"),
+    }
+
+
 def _fmt(v: Any) -> str:
     if v is None:
         return "-"
@@ -989,6 +1038,34 @@ def render(summary: Dict[str, Any]) -> str:
                 f"    {'flush queue/pad/device/reply':<32} "
                 + " / ".join(_fmt(s.get('p50')) for s in stages)
                 + " ms (p50)")
+    mem = memory_table(summary)
+    if mem:
+        lines.append("  MEMORY (device ledger):")
+        for name, v in sorted(mem["owners"].items(),
+                              key=lambda kv: -(kv[1] or 0)):
+            lines.append(f"    {name:<32} {_fmt(v / 2**20)} MB")
+        live = mem["live_bytes"]
+        peak = mem["peak_bytes"]
+        lines.append(
+            f"    {'live / peak (MB)':<32} "
+            f"{_fmt(live / 2**20 if live is not None else None)} / "
+            f"{_fmt(peak / 2**20 if peak is not None else None)}")
+        cap = mem["capacity_bytes"]
+        if cap:
+            util = mem["utilization_fraction"]
+            lines.append(
+                f"    {'capacity (MB) / utilization':<32} "
+                f"{_fmt(cap / 2**20)} / "
+                f"{_fmt(util) if util is not None else '-'}")
+        if mem["host_live_bytes"]:
+            lines.append(f"    {'host-resident (MB)':<32} "
+                         f"{_fmt(mem['host_live_bytes'] / 2**20)}")
+        if mem["reload_peak_bytes"]:
+            lines.append(f"    {'serve reload peak (MB)':<32} "
+                         f"{_fmt(mem['reload_peak_bytes'] / 2**20)}")
+        if mem["pressure_events"]:
+            lines.append(f"    {'pressure episodes':<32} "
+                         f"{_fmt(mem['pressure_events'])}")
     eff = efficiency_table(summary)
     if eff:
         lines.append("  EFFICIENCY (step anatomy):")

@@ -132,7 +132,8 @@ class RunTelemetry:
                  flush_steps: int = 0, trace_spans: bool = False,
                  protocol_trace: bool = False,
                  watchdog_stall_seconds: float = 0.0,
-                 anatomy: bool = True):
+                 anatomy: bool = True,
+                 mem_pressure_fraction: float = 0.0):
         self.registry = MetricsRegistry()
         self.sink = JsonlSink(path, meta=meta)
         self.flush_steps = max(0, int(flush_steps))
@@ -150,6 +151,11 @@ class RunTelemetry:
         # flush derives from host counters below — near-zero cost, and
         # NEVER a device fetch (pinned by tests/test_anatomy.py).
         self.anatomy = bool(anatomy)
+        # HBM pressure threshold (obs/memory.py; README "Memory
+        # observability"): fraction of device capacity at which a
+        # flush emits health: hbm_pressure (once per episode). 0
+        # disables; also inert when the backend reports no capacity.
+        self.mem_pressure_fraction = float(mem_pressure_fraction or 0.0)
         # Compute-plane liveness (parallel/liveness.py): the train/
         # predict drivers attach their HeartbeatLease here so every
         # metrics flush carries per-worker liveness gauges (the fmstat
@@ -247,6 +253,17 @@ class RunTelemetry:
             for k, v in rows.items():
                 self.registry.set(k, v)
             snap["gauges"].update(rows)
+        # Device-memory ledger (obs/memory.py): per-owner bytes, live
+        # total, peak watermark, capacity + utilization — pure host
+        # arithmetic over registered owners, NEVER a device fetch
+        # (pinned by tests/test_memory.py, same contract as anatomy).
+        from fast_tffm_tpu.obs import memory as _mem
+        rows = _mem.ledger_gauges()
+        if rows:
+            for k, v in rows.items():
+                self.registry.set(k, v)
+            snap["gauges"].update(rows)
+            _mem.maybe_emit_pressure(self)
         self.sink.emit_metrics(step, snap)
 
     def close(self, step: int = -1) -> None:
@@ -393,7 +410,9 @@ def make_telemetry(cfg, kind: str,
         protocol_trace=getattr(cfg, "protocol_trace", False),
         watchdog_stall_seconds=getattr(cfg, "watchdog_stall_seconds",
                                        0.0),
-        anatomy=getattr(cfg, "anatomy", True))
+        anatomy=getattr(cfg, "anatomy", True),
+        mem_pressure_fraction=getattr(cfg, "mem_pressure_fraction",
+                                      0.0))
 
 
 def batch_payload_bytes(args: Dict[str, Any]) -> int:

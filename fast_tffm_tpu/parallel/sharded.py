@@ -354,6 +354,7 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
     from jax.experimental import multihost_utils
     from fast_tffm_tpu.data.pipeline import empty_batch
     from fast_tffm_tpu.models.fm import batch_args
+    from fast_tffm_tpu.obs.memory import LEDGER
     from fast_tffm_tpu.obs.telemetry import active
     from fast_tffm_tpu.obs.trace import anatomy_on, span
     from fast_tffm_tpu.parallel.liveness import guarded_collective
@@ -444,6 +445,7 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
                 yield batch, local
             if tel is not None:
                 tel.count("lockstep/preempted_windows")
+            LEDGER.release("lockstep_window")
             return
         rounds = int(flags[:, 0].max())
         if tel is not None:
@@ -465,6 +467,7 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
             # deferred window and end the sweep.
             for batch, local in _drain(pending_prev, wid_prev):
                 yield batch, local
+            LEDGER.release("lockstep_window")
             return
         pending = []
         t_disp = _time.perf_counter()
@@ -508,6 +511,11 @@ def lockstep_score_batches(cfg: FmConfig, it, mesh: Mesh, score_fn,
         fetched = _drain(pending_prev, wid_prev)
         pending_prev = pending
         wid_prev = wid
+        # Ledger (obs/memory.py): the deferred window's [B_global]
+        # score vectors held in HBM until the next round's drain —
+        # .nbytes is host metadata, upserted once per window.
+        LEDGER.register("lockstep_window",
+                        sum(s.nbytes for _, s in pending))
         for batch, local in fetched:
             # This process's rows of the global [B_global] score vector
             # are exactly its local batch (global_batch concatenates

@@ -245,6 +245,13 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
         vstep = int(getattr(backend, "step", -1))
     elif table is None:
         table, vstep = load_table(cfg, mesh, with_step=True)
+    if table is not None:
+        # Ledger (obs/memory.py): the sweep's resident table — .nbytes
+        # is host metadata, no fetch. Upserted per sweep; the process-
+        # global ledger carries it for the mem/* gauges and any OOM's
+        # owner breakdown.
+        from fast_tffm_tpu.obs.memory import LEDGER
+        LEDGER.register("table", int(table.nbytes))
     if not admit:
         # The inverse loud-failure of the admit-without-sidecar raise
         # below: an admit-trained table scored through modulo ids
@@ -304,6 +311,8 @@ def _predict_body(cfg: FmConfig, table, logger) -> List[str]:
         writer.close()
     finally:
         writer.close(raise_error=False)
+        from fast_tffm_tpu.obs.memory import LEDGER
+        LEDGER.release("table")
     # fmlint: disable=R003 -- closes the predict/seconds sample
     dt = time.perf_counter() - t0
     rate = n / dt if dt > 0 else 0.0

@@ -113,17 +113,21 @@ class CompiledScorer:
         labels/weights dropped here so callers can't accidentally ship
         them. Deliberately does not materialize to numpy (see
         make_batch_scorer: a per-batch fetch collapses async
-        dispatch)."""
-        wb = self.encoder.encode_score(batch)
-        if wb.packed:
-            if self.backend is not None:
-                gathered = self.backend.gather(wb.host_uniq)
-                return self._packed_fn(wb.L, gathered, **wb.args)
-            args = self.encoder.device_put(wb)
-            return self._packed_fn(wb.L, table, **args)
-        args = (self.encoder.device_put(wb) if self._stage
-                else dict(wb.args))
-        return self._score(table, args)
+        dispatch). The ONE dispatch for batch predict and serving,
+        so it runs under oom_guard: RESOURCE_EXHAUSTED re-raises with
+        the per-owner ledger attached (obs/memory.py)."""
+        from fast_tffm_tpu.obs.memory import oom_guard
+        with oom_guard("score/dispatch"):
+            wb = self.encoder.encode_score(batch)
+            if wb.packed:
+                if self.backend is not None:
+                    gathered = self.backend.gather(wb.host_uniq)
+                    return self._packed_fn(wb.L, gathered, **wb.args)
+                args = self.encoder.device_put(wb)
+                return self._packed_fn(wb.L, table, **args)
+            args = (self.encoder.device_put(wb) if self._stage
+                    else dict(wb.args))
+            return self._score(table, args)
 
     def score_packed_shape(self, table, B: int, L: int, P: int):
         """Dispatch an all-padding synthetic batch at one

@@ -59,10 +59,22 @@ class ReloadWatcher:
     def poll_once(self) -> bool:
         """One tick: read the pointer, record what it says (the
         published-step gauge), reload when it moved. Returns True when
-        a reload was attempted."""
+        a reload was attempted. A reload that would not fit beside the
+        resident table (the old+new transient, obs/memory.py) is
+        refused inside ``_load_step`` and lands on the same
+        counted-failure keep-serving path as a failed restore — the
+        headroom gauge below is the early-warning signal fmstat/
+        fmtrace watch before that happens."""
         # A live poll IS liveness: without this, a traffic-idle server
         # under a configured stall watchdog reads as STALLED.
         self._server.idle_beat()
+        from fast_tffm_tpu.obs.memory import (LEDGER,
+                                              device_capacity_bytes)
+        cap = device_capacity_bytes()
+        if cap:
+            self._server._reg.set(
+                "serve/reload_headroom_bytes",
+                float(cap - LEDGER.live_bytes()))
         step = read_published(self._server.directory)
         if step is None:
             return False

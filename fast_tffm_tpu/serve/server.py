@@ -174,6 +174,13 @@ class ScorerServer:
         # Every parsed example must fit the compiled ladder — the
         # no-recompile guarantee is a shape guarantee.
         require_bounded_examples(cfg, "online serving")
+        # Pre-flight capacity (obs/memory.py): the serve plan includes
+        # the old+new reload transient — a table that fits alone but
+        # cannot hot-reload is an operational trap, refused at startup
+        # with the planner's breakdown. No-op when the backend reports
+        # no capacity (CPU container).
+        from fast_tffm_tpu.obs.memory import preflight_capacity
+        preflight_capacity(cfg, "serve")
         self.cfg = cfg
         self._logger = logger or get_logger(log_file=cfg.log_file
                                             or None)
@@ -303,22 +310,56 @@ class ScorerServer:
         whole (the previous coherent triple keeps serving) rather
         than pairing a new table with an old map."""
         from fast_tffm_tpu.predict import load_table
+        from fast_tffm_tpu.obs.memory import (LEDGER,
+                                              device_capacity_bytes,
+                                              oom_guard, render_ledger,
+                                              table_bytes)
+        # Reload transient (README "Memory observability"): a hot
+        # reload holds old+new tables until the swap — a silent 2x
+        # spike, now gauged per reload. A reload that would EXCEED
+        # capacity is refused here, which reload_step turns into the
+        # counted-failure keep-serving path (the old coherent triple
+        # keeps serving) instead of an XLA OOM killing the fleet.
+        old_bytes = LEDGER.owners().get("serve_table", 0)
+        new_bytes = table_bytes(self.cfg)
+        if old_bytes:
+            cap = device_capacity_bytes()
+            if cap and LEDGER.live_bytes() + new_bytes > cap:
+                raise RuntimeError(
+                    f"hot reload of step {step} refused: old+new "
+                    f"tables would exceed device capacity "
+                    f"({LEDGER.live_bytes() + new_bytes:,} > {cap:,} "
+                    f"bytes)\n{render_ledger()}")
+            LEDGER.register("serve_reload_table", new_bytes)
         vmap = None
-        if self._admit:
-            # The shared inference loader: raises on a missing/torn
-            # sidecar — the reload fails whole and the previous
-            # coherent triple keeps serving.
-            from fast_tffm_tpu.checkpoint import load_vocab_map
-            vmap = load_vocab_map(self.cfg, self.directory, step)
-        else:
-            from fast_tffm_tpu.checkpoint import (
-                refuse_fixed_mode_admit_step)
-            refuse_fixed_mode_admit_step(self.cfg, self.directory, step)
-        table = load_table(self.cfg, step=step)
+        try:
+            if self._admit:
+                # The shared inference loader: raises on a missing/torn
+                # sidecar — the reload fails whole and the previous
+                # coherent triple keeps serving.
+                from fast_tffm_tpu.checkpoint import load_vocab_map
+                vmap = load_vocab_map(self.cfg, self.directory, step)
+            else:
+                from fast_tffm_tpu.checkpoint import (
+                    refuse_fixed_mode_admit_step)
+                refuse_fixed_mode_admit_step(self.cfg, self.directory,
+                                             step)
+            with oom_guard("serve/reload"):
+                table = load_table(self.cfg, step=step)
+        except BaseException:
+            LEDGER.release("serve_reload_table")
+            raise
         with self._table_lock:
             self._table = table
             self._vocab_map = vmap
             self._served_step = int(step)
+        # The transient is over once the swap commits (the old table
+        # frees when in-flight flushes drain); the gauge keeps the
+        # spike's size for fmstat/fmtrace.
+        LEDGER.release("serve_reload_table")
+        LEDGER.register("serve_table", int(table.nbytes))
+        self._reg.set("serve/reload_peak_bytes",
+                      float(old_bytes + int(table.nbytes)))
         self._reg.set("serve/served_step", float(step))
         if vmap is not None:
             self._reg.set("serve/vocab_live_rows",
@@ -636,6 +677,12 @@ class ScorerServer:
         self._dispatcher.join()
         if self._tel is not None:
             self._tel.close(step=self._flushes)
+        from fast_tffm_tpu.obs.memory import LEDGER
+        LEDGER.release("serve_table")
+        LEDGER.release("serve_reload_table")
+        # The scoring dispatch's wire double-buffer (registered by the
+        # encoder on the first flush/warmup) dies with the dispatcher.
+        LEDGER.release("wire_buffers")
         self._logger.info("scorer server closed after %d flushes",
                           self._flushes)
 

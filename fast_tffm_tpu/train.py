@@ -34,6 +34,8 @@ from fast_tffm_tpu.metrics import StreamingAUC
 from fast_tffm_tpu.models.fm import (ModelSpec, batch_args, init_accumulator,
                                      init_table, make_batch_scorer,
                                      make_train_step, ships_raw_batches)
+from fast_tffm_tpu.obs.memory import (LEDGER, oom_guard,
+                                      preflight_capacity, table_bytes)
 from fast_tffm_tpu.obs.telemetry import (active, make_telemetry,
                                          pop_active, push_active)
 from fast_tffm_tpu.obs.trace import span
@@ -359,6 +361,12 @@ def train(cfg: FmConfig, job_name: Optional[str] = None,
     guard_prev = None
     guard_installed = False
     try:
+        # Pre-flight capacity check (obs/memory.py): when the backend
+        # reports a device capacity, a config whose PREDICTED resident
+        # bytes exceed it is refused here with the planner's per-owner
+        # breakdown — not minutes later as a raw XLA OOM. No-op when
+        # capacity is unmeasured (the CPU container).
+        preflight_capacity(cfg, "train")
         shard_index, num_shards = 0, 1
         generation = 0
         members = [0]
@@ -796,6 +804,24 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
                 acc = init_accumulator(cfg)
             step_fn = make_train_step(spec)
 
+        # Ownership ledger (obs/memory.py; README "Memory
+        # observability"): the session's long-lived allocations
+        # register with their owner tag so every flush carries mem/*
+        # gauges and an OOM names which owner grew. .nbytes is host
+        # metadata — no fetch. Offload state is host-resident by
+        # construction (host=True: gauged, excluded from the device
+        # live total). Released in this session's finally.
+        if offload:
+            LEDGER.register("offload_table",
+                            table_bytes(rows=lk.rows, dim=lk.dim),
+                            host=True)
+            LEDGER.register("offload_acc",
+                            table_bytes(rows=lk.rows, dim=lk.dim),
+                            host=True)
+        else:
+            LEDGER.register("table", table.nbytes)
+            LEDGER.register("adagrad_acc", acc.nbytes)
+
         # Wire format (README "Wire format"; wire.py): resolve the
         # knobs for THIS dispatch path, build the one encoder every
         # step ships through, and pre-build the packed step when
@@ -867,7 +893,13 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
 
         def _wire_step(wb, args, table, acc):
             """Dispatch one placed batch through the right compiled
-            step (shared by both loops, like _wire_place)."""
+            step (shared by both loops, like _wire_place). Runs under
+            oom_guard: a RESOURCE_EXHAUSTED here re-raises with the
+            per-owner ledger attached (obs/memory.py)."""
+            with oom_guard("train/step"):
+                return _wire_step_inner(wb, args, table, acc)
+
+        def _wire_step_inner(wb, args, table, acc):
             if multi_process:
                 # The sharded step IS a collective program: on a dead
                 # cluster its dispatch blocks inside the program's
@@ -2100,7 +2132,7 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             # Same size gate on EVERY dense-export path: a single-host
             # mesh whose aggregate row-sharded table exceeds host RAM
             # must not OOM assembling the .npz after a successful run.
-            nbytes = cfg.num_rows * cfg.row_dim * 4
+            nbytes = table_bytes(cfg)
             if nbytes > EXPORT_NPZ_MAX_BYTES:
                 logger.info(
                     "skipping dense .npz export: table is "
@@ -2126,6 +2158,15 @@ def _train_session(cfg: FmConfig, logger, tel, bad_tracker,
             _record_crash(tel, logger, e, global_step)
         raise
     finally:
+        # The session's resident allocations leave the ledger here —
+        # crash or clean exit — so an elastic-recovered session
+        # re-registers fresh sizes instead of double-counting, and the
+        # peak watermark (deliberately NOT reset) keeps the high-water
+        # answer across recoveries.
+        for _owner in ("table", "adagrad_acc", "offload_table",
+                       "offload_acc", "wire_buffers",
+                       "prefetch_batches", "lockstep_window"):
+            LEDGER.release(_owner)
         try:
             if worker_lost:
                 # HOST-ONLY teardown: a peer is dead, so any device
@@ -2306,7 +2347,7 @@ def _chief_finalize(cfg: FmConfig, table: jax.Array, logger, mesh,
         if jax.process_index() == 0:
             logger.info("final validation AUC %.6f over %d examples",
                         *last_val)
-    nbytes = cfg.num_rows * cfg.row_dim * 4
+    nbytes = table_bytes(cfg)
     if nbytes > EXPORT_NPZ_MAX_BYTES:
         if jax.process_index() == 0:
             logger.info(

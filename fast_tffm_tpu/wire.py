@@ -299,6 +299,12 @@ class WireEncoder:
         inline). Single-device paths only — the mesh/lockstep paths
         have their own placement (shard_batch / global_batch)."""
         import jax
+        from fast_tffm_tpu.obs.memory import LEDGER
+        # Ledger (obs/memory.py): depth-2 window — this batch's bytes
+        # on the copy stream plus the previous batch's still feeding
+        # the executing step. wire_bytes is host metadata; an upsert
+        # per put, no device interaction.
+        LEDGER.register("wire_buffers", 2 * wb.wire_bytes)
         return jax.device_put(wb.args)
 
 

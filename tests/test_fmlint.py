@@ -602,3 +602,41 @@ def test_r013_respects_pragma(tmp_path):
             return jax.device_put(0.0)
     """)
     assert [f.rule for f in run_file(path) if f.rule == "R013"] == []
+
+
+def test_r018_flags_adhoc_memory_stats(tmp_path):
+    """ISSUE 18 satellite: device-memory introspection outside the
+    obs/memory seam bypasses the unmeasured-is-None policy, the CPU
+    opt-out, and the FM_FAKE_HBM_BYTES test injection."""
+    path = _any_file(tmp_path, """\
+        import jax
+
+        def probe(dev):
+            stats = dev.memory_stats()
+            arrays = jax.live_arrays()
+            return stats, arrays
+    """, name="probe.py")
+    found = [f for f in run_file(path) if f.rule == "R018"]
+    assert len(found) == 2
+    assert "obs/memory.device_memory_stats" in found[0].message
+
+
+def test_r018_exempts_the_seam_module(tmp_path):
+    d = tmp_path / "fast_tffm_tpu" / "obs"
+    d.mkdir(parents=True)
+    p = d / "memory.py"
+    p.write_text(textwrap.dedent("""\
+        def device_memory_stats(dev):
+            return dev.memory_stats()
+    """))
+    assert [f.rule for f in run_file(str(p))
+            if f.rule == "R018"] == []
+
+
+def test_r018_respects_pragma(tmp_path):
+    path = _any_file(tmp_path, """\
+        def raw_probe(dev):
+            # fmlint: disable=R018 -- leak hunt, wants raw runtime stats
+            return dev.memory_stats()
+    """, name="probe.py")
+    assert [f.rule for f in run_file(path) if f.rule == "R018"] == []

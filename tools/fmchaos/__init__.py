@@ -2185,6 +2185,74 @@ def scenario_grow_joiner_dies(workdir: str, seed: int = 0) -> str:
             "never wedged")
 
 
+def scenario_oom_pressure(workdir: str, seed: int = 0) -> str:
+    """Capacity wall under an injected HBM size (obs/memory.py): an
+    oversized config is REFUSED by the pre-flight with the planner's
+    per-owner breakdown (and the exact what-if invocation); a
+    borderline config trains to completion while emitting
+    ``health: hbm_pressure`` exactly once per episode, and fmstat
+    renders the HBM-PRESSURE verdict."""
+    from fast_tffm_tpu.obs.memory import (FAKE_CAPACITY_ENV, LEDGER,
+                                          plan, table_bytes)
+    from fast_tffm_tpu.train import train
+    corpus = os.path.join(workdir, "train_oom.txt")
+    _write_corpus(corpus, 400, seed)
+    prev = os.environ.get(FAKE_CAPACITY_ENV)
+    LEDGER.reset()
+    try:
+        # Leg 1: predicted resident bytes (a ~2 MB table) vs a 64 KB
+        # injected capacity — refused at startup, never dispatched.
+        big = _cfg(workdir, corpus, vocabulary_size=100000,
+                   metrics_file=os.path.join(workdir,
+                                             "metrics_big.jsonl"))
+        os.environ[FAKE_CAPACITY_ENV] = str(64 * 1024)
+        refused = False
+        try:
+            train(big)
+        except ValueError as e:
+            refused = True
+            msg = str(e)
+            assert "fmstat capacity" in msg, (
+                f"pre-flight refusal must name the planner CLI: {msg}")
+            assert "predicted device total" in msg, (
+                f"pre-flight refusal must carry the breakdown: {msg}")
+        assert refused, ("oversized config started under a 64 KB "
+                         "injected capacity — pre-flight did not fire")
+        LEDGER.reset()
+        # Leg 2: borderline. The table+accumulator resident set is
+        # ~60% of the injected capacity — above the 0.5 pressure
+        # threshold at every flush (ONE episode, never re-armed), but
+        # the full predicted set still FITS, so pre-flight lets it
+        # run.
+        cfg = _cfg(workdir, corpus, vocabulary_size=20000,
+                   factor_num=8, mem_pressure_fraction=0.5)
+        resident = 2 * table_bytes(cfg)
+        cap = int(resident / 0.6)
+        assert plan(cfg, "train")["total_bytes"] <= cap, (
+            "scenario shape drifted: the borderline config no longer "
+            "fits its own injected capacity")
+        os.environ[FAKE_CAPACITY_ENV] = str(cap)
+        train(cfg)
+        h = [e for e in (_summary(cfg).get("health_events") or [])
+             if e.get("status") == "hbm_pressure"]
+        assert len(h) == 1, (
+            f"expected exactly 1 hbm_pressure episode event, got "
+            f"{len(h)}")
+        assert h[0].get("owners"), "pressure event lost its owner map"
+        v = _verdict(cfg)
+        assert v.startswith("HBM-PRESSURE"), v
+    finally:
+        if prev is None:
+            os.environ.pop(FAKE_CAPACITY_ENV, None)
+        else:
+            os.environ[FAKE_CAPACITY_ENV] = prev
+        LEDGER.reset()
+    return ("pre-flight refused the oversized config with the planner "
+            "breakdown; the borderline run trained under pressure with "
+            "exactly one hbm_pressure episode and fmstat reads "
+            "HBM-PRESSURE")
+
+
 SCENARIOS: Dict[str, Callable[..., str]] = {
     "skip": scenario_skip,
     "quarantine": scenario_quarantine,
@@ -2204,6 +2272,7 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "hang-worker": scenario_hang_worker,
     "kill-then-grow": scenario_kill_then_grow,
     "grow-joiner-dies": scenario_grow_joiner_dies,
+    "oom-pressure": scenario_oom_pressure,
 }
 
 

@@ -366,7 +366,47 @@ def r013_adhoc_device_put(path: str, tree: ast.AST) -> List[Finding]:
     return found
 
 
+# R018 scope: everywhere except the one memory seam. The runtime's
+# memory introspection (memory_stats / live_arrays) must route through
+# fast_tffm_tpu/obs/memory.device_memory_stats so the unmeasured-is-
+# None policy, the CPU-backend opt-out, and the FM_FAKE_HBM_BYTES test
+# injection hold at EVERY consumer — a direct call site sees real
+# stats where a test injected fake ones, and branches a capacity
+# decision the chaos suite cannot reach. The seam module itself is out
+# of scope by construction (same shape as R013's one-encoder rule).
+R018_SEAM_SUFFIX = "fast_tffm_tpu/obs/memory.py"
+R018_CALLS = ("memory_stats", "live_arrays")
+
+
+def r018_adhoc_memory_stats(path: str, tree: ast.AST) -> List[Finding]:
+    """Direct ``memory_stats()`` / ``live_arrays()`` outside the
+    obs/memory seam: capacity reads must share one policy (None when
+    unmeasured, CPU opt-out, fake-capacity injection). Justified
+    pragma for genuinely raw probes."""
+    p = path.replace("\\", "/")
+    if p.endswith(R018_SEAM_SUFFIX):
+        return []
+    found: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        adhoc = ((isinstance(f, ast.Name) and f.id in R018_CALLS)
+                 or (isinstance(f, ast.Attribute)
+                     and f.attr in R018_CALLS))
+        if not adhoc:
+            continue
+        found.append(Finding(
+            "R018", path, node.lineno,
+            "direct device-memory introspection bypasses the one "
+            "memory seam (obs/memory.device_memory_stats): the "
+            "unmeasured-is-None policy, the CPU-backend opt-out, and "
+            "the FM_FAKE_HBM_BYTES injection only hold through the "
+            "seam; route through it, or justify with a pragma"))
+    return found
+
+
 RULES = (r001_scalar_fetch, r002_bare_print, r003_raw_perf_counter,
          r004_swallowed_exception, r005_ckpt_delete,
          r006_unguarded_collective, r011_raw_table_index,
-         r013_adhoc_device_put)
+         r013_adhoc_device_put, r018_adhoc_memory_stats)

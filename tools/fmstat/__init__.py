@@ -7,6 +7,8 @@ The read-side of the obs/ telemetry subsystem:
     python -m tools.fmstat --tail <metrics.jsonl>
     python -m tools.fmstat --follow '<metrics.jsonl>*'
     python -m tools.fmstat slo <metrics.jsonl> [shards...] [--json]
+    python -m tools.fmstat capacity <cfg> [--kind serve]
+        [--what-if vocabulary_size=N,dtype=f16,shards=K]
 
 Summary mode merges every given file (a multi-process run's chief file
 plus its ``.p<i>`` worker shards — pass a glob) through the registry's
@@ -35,6 +37,12 @@ the run's declared service-level objectives (the ``slo/*`` gauges the
 and prints a per-objective PASS/FAIL table (``--json`` for the
 machine form), exiting non-zero on any FAIL — the one scriptable
 "is this deployment healthy" answer (README "SLOs & quality gate").
+The ``capacity`` subcommand is the planner's CLI (obs/memory.py;
+README "Memory observability"): predicted per-owner resident device
+bytes for a config — before the run exists — against device capacity,
+with ``--what-if`` overrides for the sharding/quantization frontiers;
+exits non-zero on an EXCEEDS verdict. Runs with the ledger on grow a
+MEMORY section here and an ``HBM-PRESSURE`` health verdict.
 """
 
 from __future__ import annotations
@@ -196,11 +204,66 @@ def main_slo(argv=None) -> int:
     return 0
 
 
+def main_capacity(argv=None) -> int:
+    """The ``fmstat capacity`` subcommand: predict resident device
+    bytes per owner from a CONFIG (no stream needed — sizing happens
+    before the run exists) against the device capacity, with --what-if
+    overrides for the capacity frontiers (sharded tables, f16/int8
+    resident tables). Exit 1 on an EXCEEDS verdict — scriptable as a
+    deploy gate."""
+    from fast_tffm_tpu.obs.memory import (parse_what_if, plan,
+                                          render_plan)
+    ap = argparse.ArgumentParser(
+        prog="fmstat capacity",
+        description="predict per-owner resident device bytes for a "
+                    "config against device capacity (README 'Memory "
+                    "observability')")
+    ap.add_argument("config", help="config file to size")
+    ap.add_argument("--kind", choices=("train", "serve"),
+                    default="train",
+                    help="which resident set to plan: the train "
+                         "session's (table+optimizer+wire) or the "
+                         "server's (table + old+new reload transient)")
+    ap.add_argument("--what-if", default="", dest="what_if",
+                    metavar="K=V[,K=V...]",
+                    help="overrides: vocabulary_size, factor_num, "
+                         "field_num, batch_size, "
+                         "max_features_per_example, dtype "
+                         "(f32|f16|bf16|int8, resident table only), "
+                         "shards (per-device share under row "
+                         "sharding)")
+    ap.add_argument("--capacity-bytes", type=int, default=0,
+                    help="assume this device capacity instead of "
+                         "asking the backend (sizing for a target "
+                         "chip from a dev box)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the plan as JSON")
+    args = ap.parse_args(argv)
+    from fast_tffm_tpu.config import load_config
+    cfg = load_config(args.config)
+    overrides = parse_what_if(args.what_if)
+    p = plan(cfg, args.kind, overrides)
+    if args.capacity_bytes:
+        p["capacity_bytes"] = args.capacity_bytes
+        p["utilization_fraction"] = (p["total_bytes"]
+                                     / float(args.capacity_bytes))
+        p["verdict"] = ("EXCEEDS"
+                        if p["total_bytes"] > args.capacity_bytes
+                        else "FITS")
+    if args.json:
+        print(json.dumps(p, default=str))
+    else:
+        print(render_plan(p))
+    return 1 if p["verdict"] == "EXCEEDS" else 0
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "slo":
         return main_slo(argv[1:])
+    if argv and argv[0] == "capacity":
+        return main_capacity(argv[1:])
     ap = argparse.ArgumentParser(
         prog="fmstat", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)

@@ -71,6 +71,7 @@ _UNIT_RULES = (
     ("bytes", "B"),
     ("per_sec", "1/s"),
     ("examples", "examples"),
+    ("fraction", "ratio"),  # mem/utilization_fraction and kin
 )
 
 
