@@ -19,6 +19,10 @@
 #                   planner-vs-ledger and peak-vs-model ratios off a
 #                   real train run, serve reload spike off a real
 #                   hot reload
+#   make bench-fleet  serving-fleet latency line: client-side p50/p99
+#                   and req/s through the failover proxy at 1 vs 3
+#                   replicas (real child processes), scaling factor
+#                   pinned as throughput_x
 #   make lint       fmlint whole-program pass (R000-R017) over
 #                   fast_tffm_tpu/, tools/, run_tffm.py, bench.py;
 #                   writes the machine-readable findings artifact to
@@ -81,6 +85,9 @@ bench-wire: $(SO)
 bench-memory: $(SO)
 	JAX_PLATFORMS=cpu python bench.py --memory
 
+bench-fleet: $(SO)
+	JAX_PLATFORMS=cpu python bench.py --fleet
+
 lint:
 	python -m tools.fmlint --profile --json-out .fmlint_cache/findings.json
 
@@ -116,4 +123,4 @@ anatomy:
 clean:
 	rm -f $(SO)
 
-.PHONY: all test bench bench-host bench-predict bench-vocab bench-wire bench-memory bench-multihost bench-diff anatomy lint chaos stream-soak serve serve-soak slo-soak grow-soak clean
+.PHONY: all test bench bench-host bench-predict bench-vocab bench-wire bench-memory bench-fleet bench-multihost bench-diff anatomy lint chaos stream-soak serve serve-soak slo-soak grow-soak clean

@@ -813,6 +813,137 @@ def run_serve_latency(tmp):
     }
 
 
+# Fleet-latency line shape (ISSUE 19): the serving soak's traffic
+# through the REAL front door — FleetSupervisor children behind the
+# failover proxy over loopback HTTP — with a fixed request count per
+# client so req/s is a client-side measurement, comparable between the
+# single-replica baseline and the fleet shape.
+FLEET_REPLICAS = 3
+FLEET_CLIENTS = 8
+FLEET_REQUESTS_PER_CLIENT = 60
+
+
+def run_fleet_latency(tmp):
+    """The serving fleet's bench line (README "Serving fleet"): train
+    and publish once, then run the SAME fixed concurrent-client load
+    against two real front doors — ONE directly-served replica child
+    (what ``run_tffm.py serve`` is) and the ``FleetSupervisor`` fleet
+    behind the failover proxy. ``throughput_x`` is therefore the whole
+    fleet claim: fan-out gain minus the proxy hop's cost, measured
+    client-side over loopback HTTP (each replica is a real child
+    process paying its own admission queue)."""
+    import dataclasses as dc
+    import http.client
+    import threading
+    from fast_tffm_tpu.checkpoint import CheckpointState, list_step_dirs
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.serve.fleet import FleetSupervisor, ReplicaProc
+    from fast_tffm_tpu.train import train
+    from tools.fmchaos import (_corpus_lines, _fleet_cfg_file,
+                               _free_port_block, _write_corpus)
+    from tools.fmckpt import cmd_publish
+
+    wd = os.path.join(tmp, "fleet")
+    os.makedirs(wd, exist_ok=True)
+    data = os.path.join(wd, "train.txt")
+    _write_corpus(data, 400, 0)
+    # Train + publish ONCE; both front doors serve this step.
+    cfg_path = _fleet_cfg_file(
+        wd, data, replicas=FLEET_REPLICAS,
+        base_port=_free_port_block(FLEET_REPLICAS + 1),
+        serve_max_batch=64)
+    cfg = load_config(cfg_path)
+    train(dc.replace(cfg, metrics_file=""))
+    ckpt = CheckpointState(cfg.model_file)
+    step = list_step_dirs(ckpt.directory)[-1]
+    ckpt.close()
+    if cmd_publish(cfg.model_file + ".ckpt", step) != 0:
+        raise RuntimeError(f"publish of step {step} failed")
+    req_pool = _corpus_lines(60, seed=99)
+
+    def soak(port, replicas):
+        lat, failures = [], []
+        lock = threading.Lock()
+
+        def fire(worker):
+            rng = np.random.default_rng(worker)
+            try:
+                for _ in range(FLEET_REQUESTS_PER_CLIENT):
+                    k = int(rng.integers(1, 6))
+                    lo = int(rng.integers(0, len(req_pool) - k))
+                    body = ("\n".join(req_pool[lo:lo + k])
+                            + "\n").encode("utf-8")
+                    t0 = time.perf_counter()
+                    conn = http.client.HTTPConnection(
+                        "127.0.0.1", port, timeout=60)
+                    try:
+                        conn.request(
+                            "POST", "/score", body=body,
+                            headers={"Content-Type": "text/plain"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        if resp.status != 200:
+                            raise RuntimeError(f"HTTP {resp.status}")
+                    finally:
+                        conn.close()
+                    with lock:
+                        lat.append((time.perf_counter() - t0) * 1e3)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                failures.append(repr(e))
+
+        threads = [threading.Thread(target=fire, args=(i,))
+                   for i in range(FLEET_CLIENTS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if failures:
+            raise RuntimeError(
+                f"{len(failures)} client failure(s): {failures[:3]}")
+        return {
+            "replicas": replicas,
+            "p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "p99_ms": round(float(np.percentile(lat, 99)), 2),
+            "requests": len(lat),
+            "requests_per_sec": round(len(lat) / dt, 1),
+        }
+
+    # Baseline: one replica child served DIRECTLY on its own port —
+    # this is `run_tffm.py serve` (no proxy hop in the path).
+    solo = ReplicaProc(0, cfg, cfg_path)
+    solo.spawn()
+    try:
+        deadline = time.monotonic() + 300
+        while not solo.is_ready():
+            if time.monotonic() > deadline:
+                raise RuntimeError("baseline replica never became ready")
+            time.sleep(0.1)
+        single = soak(solo.port, 1)
+    finally:
+        solo.terminate()
+        solo.reap()
+
+    sup = FleetSupervisor(cfg, cfg_path).start()
+    try:
+        if not sup.wait_ready(FLEET_REPLICAS, timeout=300):
+            raise RuntimeError(
+                f"fleet never reached {FLEET_REPLICAS} ready replicas")
+        fleet = soak(sup.proxy_port, FLEET_REPLICAS)
+    finally:
+        sup.stop()
+    return {
+        "single": single,
+        "fleet": fleet,
+        "clients": FLEET_CLIENTS,
+        "requests_per_client": FLEET_REQUESTS_PER_CLIENT,
+        "throughput_x": round(fleet["requests_per_sec"]
+                              / single["requests_per_sec"], 2)
+        if single["requests_per_sec"] else None,
+    }
+
+
 def run_quality_eval_cost(cfg):
     """The per-publish quality loop's cost line (README "SLOs & quality
     gate"): one full validation sweep through train.evaluate WITH the
@@ -1139,6 +1270,24 @@ def serve_latency_main():
         "metric": "serve_request_latency_ms",
         "value": res["p99_ms"],
         "unit": "ms (p99)",
+        **res,
+    }))
+
+
+def fleet_main():
+    """Standalone serving-fleet line (`python bench.py --fleet` /
+    `make bench-fleet`): run_fleet_latency without the rest of the
+    bench — the fleet's client-side p99 as the headline, with the
+    single-replica-behind-the-proxy baseline and the req/s scaling
+    factor beside it. One JSON line."""
+    import tempfile
+    _enable_compile_cache()
+    with tempfile.TemporaryDirectory() as tmp:
+        res = run_fleet_latency(tmp)
+    print(json.dumps({
+        "metric": "fleet_request_latency_ms",
+        "value": res["fleet"]["p99_ms"],
+        "unit": f"ms (p99, {FLEET_REPLICAS} replicas behind the proxy)",
         **res,
     }))
 
@@ -1483,6 +1632,8 @@ if __name__ == "__main__":
         vocab_overhead_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--serve":
         serve_latency_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fleet":
+        fleet_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--multihost":
         multihost_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--compare":

@@ -306,6 +306,53 @@ def write_published(directory: str, step: int) -> str:
     return path
 
 
+# Canary pointer (README "Serving fleet"): a SECOND pointer file
+# beside ``published``, repointed by ``fmckpt publish --canary``. The
+# fleet's canary replica follows it, so a candidate step can take a
+# configured traffic fraction (or shadow traffic) before the real
+# pointer moves — promotion is then an ordinary ``fmckpt publish`` of
+# the same step, rollback is deleting/repointing the canary pointer.
+CANARY_POINTER = "published-canary"
+
+
+def read_canary(directory: str) -> Optional[int]:
+    """The step the ``published-canary`` pointer names, or None (no
+    canary in flight / unreadable / garbled — same healing contract as
+    read_published)."""
+    try:
+        # fmlint: disable=R010 -- scorer-side poll: absent is the
+        # normal no-canary state and any flake reads as "no canary"
+        # on this attempt, healed by the next poll
+        with open(os.path.join(directory, CANARY_POINTER),
+                  encoding="utf-8") as fh:
+            return int(fh.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def write_canary(directory: str, step: int) -> str:
+    """Atomically repoint the canary pointer (same tmp+fsync+rename
+    sequence as write_published, same torn-read-free contract).
+    Callers own verification, exactly as for the real pointer."""
+    path = os.path.join(directory, CANARY_POINTER)
+    _atomic_write_text(path, f"{int(step)}\n")
+    return path
+
+
+def read_pointer(directory: str, pointer: str = "published"
+                 ) -> Optional[int]:
+    """Resolve a scorer's configured pointer (``serve_pointer``):
+    ``published`` reads the real pointer; ``canary`` reads the canary
+    pointer, falling back to ``published`` until a canary step exists
+    (a canary replica with nothing to canary serves the fleet's
+    step)."""
+    if pointer == "canary":
+        step = read_canary(directory)
+        if step is not None:
+            return step
+    return read_published(directory)
+
+
 # Sidecar of the published pointer: the validation AUC of the last
 # SUCCESSFUL publish — the publish gate's drop baseline
 # (obs/quality.PublishGate). It describes the POINTER (not a step), so

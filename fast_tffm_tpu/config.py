@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import configparser
 import dataclasses
+import os
 from typing import Tuple
 
 
@@ -354,6 +355,63 @@ class FmConfig:
     # Hot-reload poll cadence: how often the server re-reads the
     # ``published`` pointer file looking for a newly published step.
     serve_poll_seconds: float = 2.0
+    # Seeded per-replica jitter on the reload poll, as a fraction of
+    # serve_poll_seconds: each tick waits poll * (1 ± U(0, jitter)),
+    # seeded by the replica's port, so N replicas never stat the
+    # shared pointer file in lockstep (thundering herd on a network
+    # filesystem). 0 = fixed cadence.
+    serve_poll_jitter: float = 0.2
+    # --- serving fleet (README "Serving fleet"; serve/fleet.py) ------
+    # Replica count for ``run_tffm.py serve --replicas N`` (the CLI
+    # flag overrides this knob). Replica i binds serve_port + i, the
+    # failover proxy binds serve_proxy_port. 1 = the single-process
+    # scorer, no supervisor or proxy.
+    serve_replicas: int = 1
+    # TCP port for the fleet's reverse proxy (the client-facing front
+    # door: POST /score with retry/failover, GET /healthz aggregated
+    # over the fleet). 0 = ephemeral (logged at startup).
+    serve_proxy_port: int = 7080
+    # How many times the proxy re-sends an idempotent POST /score to a
+    # DIFFERENT ready replica after a connection-refused / timeout /
+    # 5xx, before the client sees a 503. 0 = no retries.
+    serve_retry_budget: int = 1
+    # Session-affinity header: requests carrying this header hash
+    # (rendezvous) onto one replica, so a user's burst coalesces into
+    # one micro-batch flush instead of spraying the fleet. Empty
+    # string disables affinity routing.
+    serve_affinity_header: str = "X-FM-Affinity"
+    # Fraction of proxy traffic directed at the canary replica (the
+    # last replica, serving the ``published-canary`` pointer) when a
+    # canary step is published. 0 = no canary traffic split.
+    serve_canary_fraction: float = 0.0
+    # Shadow mode: duplicate sampled traffic to the canary replica in
+    # the background, score and COMPARE (proxy/canary_score_delta
+    # gauge) but never return canary scores to clients. Implies the
+    # canary replica receives no primary traffic.
+    serve_canary_shadow: bool = False
+    # Supervisor restart backoff base: a dead replica restarts after
+    # this many seconds, doubling per consecutive failure (capped at
+    # 16x), reset once the replica reports healthy again.
+    serve_restart_backoff_seconds: float = 1.0
+    # Who drives hot reloads: "poll" (default) — the in-process
+    # watcher reloads when the pointer moves; "external" — the
+    # watcher only records the pointer (gauges stay fresh) and an
+    # external coordinator (the fleet supervisor's staggered-reload
+    # protocol) triggers reloads via POST /reload.
+    serve_reload_mode: str = "poll"
+    # Which pointer file this scorer follows: "published" (default)
+    # or "canary" (the ``published-canary`` pointer, falling back to
+    # ``published`` until a canary step exists). The fleet supervisor
+    # sets "canary" on the canary replica.
+    serve_pointer: str = "published"
+    # Bound on concurrently in-flight proxied /score requests: beyond
+    # it the proxy sheds with 503 + Retry-After instead of wedging an
+    # unbounded pile of connection threads.
+    serve_proxy_max_inflight: int = 64
+    # Supervisor health-poll cadence: how often each replica's
+    # /healthz is read for the alive/ready split (restart decisions
+    # ride "alive", proxy routing rides "ready").
+    serve_health_poll_seconds: float = 0.5
 
     # --- [Cluster] ---------------------------------------------------------
     # Reference: ps_hosts/worker_hosts for the TF1 PS runtime (SURVEY §3.2).
@@ -685,6 +743,58 @@ class FmConfig:
             raise ValueError(
                 f"serve_poll_seconds must be > 0, got "
                 f"{self.serve_poll_seconds}")
+        if not 0.0 <= self.serve_poll_jitter < 1.0:
+            raise ValueError(
+                f"serve_poll_jitter must be in [0, 1) (a fraction of "
+                f"serve_poll_seconds), got {self.serve_poll_jitter}")
+        if self.serve_replicas < 1:
+            raise ValueError(
+                f"serve_replicas must be >= 1, got "
+                f"{self.serve_replicas}")
+        if self.serve_replicas > 1 and self.serve_port == 0:
+            raise ValueError(
+                "serve_replicas > 1 needs an explicit serve_port: "
+                "replica i binds serve_port + i, so an ephemeral base "
+                "port cannot lay out the fleet")
+        if not 0 <= self.serve_proxy_port <= 65535:
+            raise ValueError(
+                f"serve_proxy_port must be in [0, 65535] (0 = "
+                f"ephemeral), got {self.serve_proxy_port}")
+        if self.serve_retry_budget < 0:
+            raise ValueError(
+                f"serve_retry_budget must be >= 0 (0 = no retries), "
+                f"got {self.serve_retry_budget}")
+        if not 0.0 <= self.serve_canary_fraction <= 1.0:
+            raise ValueError(
+                f"serve_canary_fraction must be in [0, 1], got "
+                f"{self.serve_canary_fraction}")
+        if ((self.serve_canary_fraction > 0 or self.serve_canary_shadow)
+                and self.serve_replicas < 2):
+            raise ValueError(
+                "canary scoring (serve_canary_fraction > 0 or "
+                "serve_canary_shadow) needs serve_replicas >= 2: the "
+                "canary is one replica of the fleet, and the rest must "
+                "still carry primary traffic")
+        if self.serve_restart_backoff_seconds <= 0:
+            raise ValueError(
+                f"serve_restart_backoff_seconds must be > 0, got "
+                f"{self.serve_restart_backoff_seconds}")
+        if self.serve_reload_mode not in ("poll", "external"):
+            raise ValueError(
+                f"unknown serve_reload_mode {self.serve_reload_mode!r} "
+                "(want poll | external)")
+        if self.serve_pointer not in ("published", "canary"):
+            raise ValueError(
+                f"unknown serve_pointer {self.serve_pointer!r} "
+                "(want published | canary)")
+        if self.serve_proxy_max_inflight < 1:
+            raise ValueError(
+                f"serve_proxy_max_inflight must be >= 1, got "
+                f"{self.serve_proxy_max_inflight}")
+        if self.serve_health_poll_seconds <= 0:
+            raise ValueError(
+                f"serve_health_poll_seconds must be > 0, got "
+                f"{self.serve_health_poll_seconds}")
         if self.cluster_connect_timeout_seconds <= 0:
             raise ValueError(
                 f"cluster_connect_timeout_seconds must be > 0, got "
@@ -862,6 +972,18 @@ _SERVE_KEYS = {
     "serve_max_batch": int,
     "serve_max_wait_ms": float,
     "serve_poll_seconds": float,
+    "serve_poll_jitter": float,
+    "serve_replicas": int,
+    "serve_proxy_port": int,
+    "serve_retry_budget": int,
+    "serve_affinity_header": str,
+    "serve_canary_fraction": float,
+    "serve_canary_shadow": bool,
+    "serve_restart_backoff_seconds": float,
+    "serve_reload_mode": str,
+    "serve_pointer": str,
+    "serve_proxy_max_inflight": int,
+    "serve_health_poll_seconds": float,
 }
 _CLUSTER_KEYS = {
     "ps_hosts": _split_files,
@@ -928,3 +1050,34 @@ def load_config(path: str) -> FmConfig:
             "partition the table across parameter servers; here the device "
             "mesh decides row sharding (parallel/sharded.py)")
     return cfg
+
+
+def apply_env_overrides(cfg: FmConfig) -> FmConfig:
+    """Per-process one-off overrides from ``FM_<KNOB>`` env vars —
+    the convention run_tffm.py applies to every CLI run, and the
+    fleet supervisor uses to steer each replica child (its own
+    ``serve_port``, its metrics shard, external reload mode, the
+    canary pointer) without writing N config files. Every variable
+    name maps to a real knob (fmlint R009 pins this), and the values
+    go through dataclasses.replace, so they get the same
+    ``__post_init__`` validation a config file does."""
+    updates = {}
+    v = os.environ.get("FM_METRICS_FILE")
+    if v:
+        updates["metrics_file"] = v
+    v = os.environ.get("FM_TRACE_SPANS", "")
+    if v.strip().lower() in ("1", "true", "yes", "on"):
+        updates["trace_spans"] = True
+    v = os.environ.get("FM_WATCHDOG_STALL_SECONDS")
+    if v:
+        updates["watchdog_stall_seconds"] = float(v)
+    v = os.environ.get("FM_SERVE_PORT")
+    if v:
+        updates["serve_port"] = int(v)
+    v = os.environ.get("FM_SERVE_RELOAD_MODE")
+    if v:
+        updates["serve_reload_mode"] = v
+    v = os.environ.get("FM_SERVE_POINTER")
+    if v:
+        updates["serve_pointer"] = v
+    return dataclasses.replace(cfg, **updates) if updates else cfg

@@ -274,6 +274,23 @@ def attribution(summary: Dict[str, Any]) -> Dict[str, Any]:
     out["serve_served_step"] = g.get("serve/served_step")
     out["serve_published_step"] = g.get("serve/published_step")
 
+    # Serving fleet (README "Serving fleet"; serve/fleet.py): the
+    # supervisor's aggregate counts plus the proxy's routing
+    # accounting — the FLEET render section and the FLEET DEGRADED
+    # verdict read these.
+    out["fleet_replicas"] = g.get("fleet/replicas")
+    out["fleet_ready"] = g.get("fleet/ready")
+    out["fleet_alive"] = g.get("fleet/alive")
+    out["fleet_restarts"] = c.get("fleet/restarts", 0)
+    out["fleet_reloads"] = c.get("fleet/reloads", 0)
+    out["fleet_reload_failures"] = c.get("fleet/reload_failures", 0)
+    out["proxy_requests"] = c.get("proxy/requests", 0)
+    out["proxy_retries"] = c.get("proxy/retries", 0)
+    out["proxy_shed_503"] = c.get("proxy/shed_503", 0)
+    out["proxy_unrouted_503"] = c.get("proxy/unrouted_503", 0)
+    out["proxy_canary_requests"] = c.get("proxy/canary_requests", 0)
+    out["proxy_canary_score_delta"] = g.get("proxy/canary_score_delta")
+
     # Predict-path stats (a predict stream has no train loop at all;
     # both can coexist in one file — e.g. train-then-predict appends).
     p_ex = c.get("predict/examples", 0)
@@ -629,6 +646,23 @@ def health_verdict(summary: Dict[str, Any]) -> Dict[str, Any]:
                      f"%){top_note}. Size a fix before the OOM: python "
                      "-m tools.fmstat capacity <cfg> --what-if "
                      "vocabulary_size=...,dtype=f16,shards=K"] + notes)}
+    deg = fleet_degraded(summary)
+    if deg is not None:
+        # Ranked above STALE PUBLISH / STALE MODEL: a fleet running
+        # below strength is an availability incident NOW (one more
+        # death may zero the ready set), while staleness is a
+        # freshness problem — and a dead replica is often exactly why
+        # a reload hasn't landed, so name the cause first.
+        ready, total = deg
+        return {"verdict": f"FLEET DEGRADED ({ready}/{total} ready)",
+                "detail": "; ".join(
+                    [f"{total - ready} of {total} serving replicas "
+                     "not ready at the last flush — the proxy routes "
+                     "around them while the supervisor restarts "
+                     "(capped backoff) or drains a reload; check "
+                     "fleet/restarts and the per-replica rows "
+                     "(python -m tools.fmstat <supervisor metrics>)"]
+                    + notes)}
     stale = stale_publish(summary)
     if stale is not None:
         # Checked BEFORE the unclosed-stream heuristic: a live stream
@@ -697,6 +731,49 @@ def stale_publish(summary: Dict[str, Any]
 # Publish-freshness ceiling, in intervals: past this the health verdict
 # flips to STALE PUBLISH (the serving fleet is reloading old state).
 STALE_PUBLISH_MULTIPLE = 3.0
+
+
+def fleet_degraded(summary: Dict[str, Any]
+                   ) -> Optional[Tuple[int, int]]:
+    """(ready, total) when a fleet supervisor's last flush shows
+    fewer ready replicas than the fleet size, else None. Only
+    meaningful for fleet streams (the fleet/replicas gauge present) —
+    the supervisor flushes eagerly on every ready-count edge, so a
+    mid-incident snapshot carries the degradation window."""
+    g = summary.get("gauges", {})
+    total = g.get("fleet/replicas")
+    ready = g.get("fleet/ready")
+    if not total or ready is None:
+        return None
+    if ready < total:
+        return int(ready), int(total)
+    return None
+
+
+def fleet_table(summary: Dict[str, Any]) -> List[str]:
+    """Per-replica rows from the SUPERVISOR's gauges
+    (``fleet/replica<i>_alive/_ready/_step/_queue_depth``): liveness
+    and readiness split (the restart-vs-route distinction), the step
+    each replica serves (a stagger or canary in flight shows as a
+    step spread), and its admission-queue depth at the last flush."""
+    g = summary.get("gauges", {})
+    idx = sorted({int(k.split("_", 1)[0][len("fleet/replica"):])
+                  for k in g
+                  if k.startswith("fleet/replica")
+                  and k.split("_", 1)[0][len("fleet/replica"):]
+                  .isdigit()})
+    rows = []
+    for i in idx:
+        alive = g.get(f"fleet/replica{i}_alive")
+        ready = g.get(f"fleet/replica{i}_ready")
+        step = g.get(f"fleet/replica{i}_step")
+        depth = g.get(f"fleet/replica{i}_queue_depth")
+        flag = ("ready" if ready else
+                ("alive" if alive else "DOWN"))
+        rows.append(
+            f"r{i}: {flag:<6} step={_fmt(step)} "
+            f"queue={_fmt(depth)}")
+    return rows
 
 
 def stale_model(summary: Dict[str, Any]
@@ -1038,6 +1115,33 @@ def render(summary: Dict[str, Any]) -> str:
                 f"    {'flush queue/pad/device/reply':<32} "
                 + " / ".join(_fmt(s.get('p50')) for s in stages)
                 + " ms (p50)")
+    if att.get("fleet_replicas"):
+        lines.append("  FLEET (serve --replicas):")
+        for k, v in (
+                ("replicas alive / ready / total",
+                 f"{_fmt(att['fleet_alive'])} / "
+                 f"{_fmt(att['fleet_ready'])} / "
+                 f"{_fmt(att['fleet_replicas'])}"),
+                ("restarts", att["fleet_restarts"]),
+                ("staggered reloads (failed)",
+                 f"{_fmt(att['fleet_reloads'])} "
+                 f"({_fmt(att['fleet_reload_failures'])})"),
+                ("proxy requests (retries)",
+                 f"{_fmt(att['proxy_requests'])} "
+                 f"({_fmt(att['proxy_retries'])})"),
+                ("proxy 503s shed / unrouted",
+                 f"{_fmt(att['proxy_shed_503'])} / "
+                 f"{_fmt(att['proxy_unrouted_503'])}"),
+        ):
+            lines.append(f"    {k:<32} {_fmt(v)}")
+        if att["proxy_canary_requests"] or \
+                att["proxy_canary_score_delta"] is not None:
+            lines.append(
+                f"    {'canary requests / score delta':<32} "
+                f"{_fmt(att['proxy_canary_requests'])} / "
+                f"{_fmt(att['proxy_canary_score_delta'])}")
+        for row in fleet_table(summary):
+            lines.append(f"    {row}")
     mem = memory_table(summary)
     if mem:
         lines.append("  MEMORY (device ledger):")

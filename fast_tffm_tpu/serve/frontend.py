@@ -11,8 +11,16 @@ framework, and a scorer's wire format is one float per input line):
                      serving checkpoint step in ``X-FM-Step``.
                      Malformed lines are 400 with the parse error (a
                      bad request fails itself, never the process).
-    GET  /healthz    JSON: served/published step, queue depth, request
+    GET  /healthz    JSON: alive/ready (liveness vs readiness — a
+                     still-precompiling or mid-reload server is alive
+                     but NOT ready; README "Serving fleet"),
+                     served/published step, queue depth, request
                      counters, latency p50/p99, uptime.
+    POST /reload     fleet-supervisor control surface: synchronously
+                     hot-reload to the step in the body (empty body =
+                     this server's configured pointer). 200 + JSON
+                     after the swap; 503 when the reload failed (the
+                     old step keeps serving).
     GET  /metrics    the obs registry (counters / gauges / histogram
                      buckets) in Prometheus text exposition format
                      (obs/prom.py) — the scrape endpoint; no JSONL
@@ -68,9 +76,27 @@ class _Handler(BaseHTTPRequestHandler):
         # NEXT request parse as garbage mid-body.
         length = int(self.headers.get("Content-Length") or 0)
         raw = self.rfile.read(length)
+        if self.path == "/reload":
+            # The fleet supervisor's reload token (README "Serving
+            # fleet"): synchronous — the 200 only lands after the
+            # swap, so the stagger protocol can re-admit this replica
+            # knowing which step it serves. Body: optional step
+            # number; empty = resolve this server's pointer.
+            try:
+                body = raw.decode("utf-8", errors="strict").strip()
+                step = int(body) if body else None
+            except ValueError as e:
+                self._reply(400, f"{e}\n".encode("utf-8"),
+                            "text/plain")
+                return
+            ok, now = self.server.fm_server.external_reload(step)
+            payload = json.dumps({"ok": ok, "step": now}) + "\n"
+            self._reply(200 if ok else 503,
+                        payload.encode("utf-8"), "application/json")
+            return
         if self.path != "/score":
-            self._reply(404, b"unknown path; POST /score\n",
-                        "text/plain")
+            self._reply(404, b"unknown path; POST /score or "
+                             b"/reload\n", "text/plain")
             return
         try:
             # decode inside the try: a non-UTF-8 body is the CALLER's
@@ -155,7 +181,14 @@ def run_serve(cfg) -> int:
     httpd = None
     t = None
     try:
-        server = ScorerServer(cfg, logger=logger)
+        # Background warmup: the front end binds (and /healthz
+        # answers alive: true, ready: false) WHILE the shape ladder
+        # compiles, instead of the old behavior where a precompiling
+        # server was invisible to health checks and then answered as
+        # servable the instant it bound. The fleet supervisor
+        # restarts on alive and the proxy routes on ready, so both
+        # need the split from the first second of a replica's life.
+        server = ScorerServer(cfg, logger=logger, warmup="background")
         if not stop.is_set():
             httpd = make_http_server(server, cfg.serve_port,
                                      host=cfg.serve_host)

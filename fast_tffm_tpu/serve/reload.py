@@ -14,6 +14,17 @@ map. Requests in flight keep the pair their flush captured: the old
 table/map is retained until the last batch referencing it drains — no
 torn scores, and every response says which step scored it.
 
+Fleet behavior (README "Serving fleet"): each tick's wait carries
+seeded jitter (``serve_poll_jitter``, seeded per replica by its port)
+so N replicas never stat the shared pointer file in lockstep — a
+thundering herd on a network filesystem. Under ``serve_reload_mode =
+external`` the watcher still polls (the published-step gauge and the
+STALE MODEL signal stay fresh) but never reloads: the fleet
+supervisor's stagger protocol owns reloads, handing each replica a
+reload token in turn via ``POST /reload``
+(``ScorerServer.external_reload``) so the fleet never cold-stops
+together.
+
 Failure posture: a garbled/unreadable pointer reads as "nothing new"
 and heals on the next poll (read_published's contract); a step that
 fails verification or restore counts a ``serve/reload_failures`` and
@@ -23,19 +34,30 @@ staleness (visible as fmstat's STALE MODEL), never to an outage.
 
 from __future__ import annotations
 
+import random
 import threading
 
-from fast_tffm_tpu.checkpoint import read_published
+from fast_tffm_tpu.checkpoint import read_pointer
 
 
 class ReloadWatcher:
     """Daemon poll thread (``fm-serve-reload``). ``poll_once`` is the
     whole per-tick protocol, public so unit tests can drive it without
-    the thread."""
+    the thread; ``next_wait`` is the jittered cadence, public for the
+    same reason. ``auto_reload=False`` is the external-coordinator
+    mode (observe-only ticks)."""
 
-    def __init__(self, server, poll_seconds: float):
+    def __init__(self, server, poll_seconds: float,
+                 jitter: float = 0.0, seed: int = 0,
+                 auto_reload: bool = True):
         self._server = server
         self._poll = float(poll_seconds)
+        self._jitter = max(0.0, min(float(jitter), 0.999))
+        # Deterministic per-replica stream: the same replica jitters
+        # the same way run to run (debuggable), different replicas
+        # (different ports) decorrelate.
+        self._rng = random.Random(int(seed) * 2654435761 + 1)
+        self._auto = bool(auto_reload)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run,
                                         name="fm-serve-reload",
@@ -45,8 +67,17 @@ class ReloadWatcher:
         self._thread.start()
         return self
 
+    def next_wait(self) -> float:
+        """One tick's wait: poll * (1 ± U(0, jitter)). Symmetric, so
+        the MEAN cadence stays serve_poll_seconds however much the
+        phase decorrelates."""
+        if not self._jitter:
+            return self._poll
+        return self._poll * (1.0 + self._rng.uniform(-self._jitter,
+                                                     self._jitter))
+
     def _run(self) -> None:
-        while not self._stop.wait(self._poll):
+        while not self._stop.wait(self.next_wait()):
             try:
                 self.poll_once()
             except Exception:  # noqa: BLE001 - the poll loop must
@@ -58,13 +89,15 @@ class ReloadWatcher:
 
     def poll_once(self) -> bool:
         """One tick: read the pointer, record what it says (the
-        published-step gauge), reload when it moved. Returns True when
-        a reload was attempted. A reload that would not fit beside the
-        resident table (the old+new transient, obs/memory.py) is
-        refused inside ``_load_step`` and lands on the same
-        counted-failure keep-serving path as a failed restore — the
-        headroom gauge below is the early-warning signal fmstat/
-        fmtrace watch before that happens."""
+        published-step gauge), reload when it moved — unless an
+        external coordinator owns reloads, in which case the tick is
+        observe-only. Returns True when a reload was attempted. A
+        reload that would not fit beside the resident table (the
+        old+new transient, obs/memory.py) is refused inside
+        ``_load_step`` and lands on the same counted-failure
+        keep-serving path as a failed restore — the headroom gauge
+        below is the early-warning signal fmstat/fmtrace watch before
+        that happens."""
         # A live poll IS liveness: without this, a traffic-idle server
         # under a configured stall watchdog reads as STALLED.
         self._server.idle_beat()
@@ -75,10 +108,14 @@ class ReloadWatcher:
             self._server._reg.set(
                 "serve/reload_headroom_bytes",
                 float(cap - LEDGER.live_bytes()))
-        step = read_published(self._server.directory)
+        step = read_pointer(self._server.directory,
+                            getattr(self._server, "_pointer",
+                                    "published"))
         if step is None:
             return False
         self._server.note_published(step)
+        if not self._auto:
+            return False
         if step == self._server.served_step:
             return False
         self._server.reload_step(step)

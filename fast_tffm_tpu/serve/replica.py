@@ -1,0 +1,53 @@
+"""Fleet replica child entry: ``python -m fast_tffm_tpu.serve.replica``.
+
+One supervised ScorerServer process (README "Serving fleet"): loads
+the config file the supervisor passes, applies the per-replica
+``FM_<KNOB>`` env overrides the supervisor set (its own
+``serve_port``, its metrics shard, ``serve_reload_mode = external``,
+and ``serve_pointer = canary`` on the canary replica), and runs the
+standard single-process serve driver — the same drain-on-SIGTERM
+lifecycle ``run_tffm.py serve`` has, which is exactly what the
+supervisor's terminate/reap sequence relies on.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from fast_tffm_tpu.config import apply_env_overrides, load_config
+
+
+def _enable_compilation_cache() -> None:
+    """Same persistent-XLA-cache policy as run_tffm.py: a RESTARTED
+    replica re-warms its shape ladder from the cache in seconds
+    instead of recompiling the matrix — the difference between a
+    restart gap and a restart outage."""
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return
+    path = os.path.join(os.path.expanduser("~"), ".cache",
+                        "fast_tffm_tpu", "jax_cache")
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0)
+    except Exception:
+        pass  # cache is an optimization; never block the replica on it
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 1:
+        print("usage: python -m fast_tffm_tpu.serve.replica <cfg>",
+              file=sys.stderr)
+        return 2
+    _enable_compilation_cache()
+    cfg = apply_env_overrides(load_config(argv[0]))
+    from fast_tffm_tpu.serve.frontend import run_serve
+    return run_serve(cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

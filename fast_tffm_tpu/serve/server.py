@@ -157,9 +157,19 @@ class ScorerServer:
         server.close()                    # drains, stops, flushes
 
     ``watch=False`` skips the reload thread (unit tests drive
-    ``reload_step`` directly; the soak runs the real watcher)."""
+    ``reload_step`` directly; the soak runs the real watcher).
 
-    def __init__(self, cfg: FmConfig, logger=None, watch: bool = True):
+    ``warmup="background"`` moves the shape-ladder precompile off the
+    constructor onto a daemon thread: the server comes up ``alive``
+    immediately (healthz answers, requests queue and score — slowly,
+    compiling on demand) but reports ``ready: false`` until the full
+    matrix is compiled. The fleet path uses this so a precompiling
+    replica is routed AROUND (proxy routes on ready), not restarted
+    (supervisor restarts on alive) — and so healthz never again claims
+    a still-compiling server is servable."""
+
+    def __init__(self, cfg: FmConfig, logger=None, watch: bool = True,
+                 warmup: str = "sync"):
         import jax
         if jax.process_count() > 1:
             raise ValueError("the serving process is single-process: "
@@ -245,13 +255,27 @@ class ScorerServer:
         self._closed = False
         self._flushes = 0
         self._start_time = time.time()
+        # Readiness, split from liveness (README "Serving fleet"):
+        # alive = the process answers (always true of a responding
+        # healthz); ready = warmed up AND not mid-reload AND the
+        # admission queue below the shed depth. The fleet proxy routes
+        # on ready; the supervisor restarts on alive.
+        self._warmed = threading.Event()
+        self._reloading = threading.Event()
+        self._warmup_error: Optional[BaseException] = None
+        self._shed_depth = max(8, 2 * cfg.serve_max_batch)
+        # Which pointer file this scorer follows (serve_pointer): the
+        # canary replica reads ``published-canary`` with fallback to
+        # ``published`` (checkpoint.read_pointer).
+        self._pointer = getattr(cfg, "serve_pointer", "published")
+        self._reg.set("serve/ready", 0.0)
         # Startup load: the published pointer IS the serving contract —
         # an unpublished directory is a config/ops error, not a wait.
         # A failed startup must close the sink it already opened (the
         # metrics stream would otherwise hold a run_start forever).
         try:
-            from fast_tffm_tpu.checkpoint import read_published
-            step = read_published(self.directory)
+            from fast_tffm_tpu.checkpoint import read_pointer
+            step = read_pointer(self.directory, self._pointer)
             if step is None:
                 raise FileNotFoundError(
                     f"no published checkpoint pointer in "
@@ -264,7 +288,14 @@ class ScorerServer:
             # the STALE MODEL gauge pair must not read published=-1
             # until the first poll tick (or forever under watch=False).
             self.note_published(step)
-            self._warmup()
+            if warmup == "background":
+                self._warmup_thread = threading.Thread(
+                    target=self._warmup_bg, name="fm-serve-warmup",
+                    daemon=True)
+                self._warmup_thread.start()
+            else:
+                self._warmup()
+                self._mark_warmed()
         except BaseException:
             if self._tel is not None:
                 self._tel.close()
@@ -277,7 +308,11 @@ class ScorerServer:
         if watch:
             from fast_tffm_tpu.serve.reload import ReloadWatcher
             self._watcher = ReloadWatcher(
-                self, poll_seconds=cfg.serve_poll_seconds).start()
+                self, poll_seconds=cfg.serve_poll_seconds,
+                jitter=getattr(cfg, "serve_poll_jitter", 0.0),
+                seed=cfg.serve_port,
+                auto_reload=(getattr(cfg, "serve_reload_mode", "poll")
+                             == "poll")).start()
         self._logger.info(
             "serving checkpoint step %d from %s (%d batch x %d width "
             "rungs pre-compiled, max_batch=%d, max_wait=%.1fms, "
@@ -384,7 +419,12 @@ class ScorerServer:
     def reload_step(self, step: int) -> bool:
         """Hot-swap to a newly published step; False (and a counted
         failure) when the step fails verification/restore — the
-        previous table keeps serving and the next poll retries."""
+        previous table keeps serving and the next poll retries. The
+        server reports ``ready: false`` for the duration: the fleet
+        proxy drains around a reloading replica instead of queueing
+        behind its table swap."""
+        self._reloading.set()
+        self._reg.set("serve/ready", 0.0)
         try:
             with span("serve/reload", step=int(step)):
                 self._load_step(step)
@@ -395,10 +435,34 @@ class ScorerServer:
                 "continuing to serve step %d", step, type(e).__name__,
                 e, self.served_step)
             return False
+        finally:
+            self._reloading.clear()
+            self._reg.set("serve/ready",
+                          1.0 if self.is_ready() else 0.0)
         self._reg.count("serve/reloads")
         self._logger.info("hot-reloaded published checkpoint step %d",
                           step)
         return True
+
+    def external_reload(self, step=None) -> Tuple[bool, int]:
+        """The ``POST /reload`` control surface — the reload token the
+        fleet supervisor's stagger protocol hands each replica in turn
+        (serve_reload_mode = external). ``step=None`` resolves this
+        server's configured pointer. Synchronous: returns (ok, the
+        step now serving) only after the swap (or its counted
+        failure), so the caller can re-admit the replica knowing which
+        step it serves."""
+        if step is None:
+            from fast_tffm_tpu.checkpoint import read_pointer
+            step = read_pointer(self.directory, self._pointer)
+            if step is None:
+                return False, self.served_step
+        step = int(step)
+        self.note_published(step)
+        if step == self.served_step:
+            return True, step
+        ok = self.reload_step(step)
+        return ok, self.served_step
 
     # -- request path ----------------------------------------------------
 
@@ -619,6 +683,9 @@ class ScorerServer:
                             jax.device_get(
                                 self._scorer.score_packed_shape(
                                     self._table, B, L, P))
+        # fmlint: disable=R008 -- single writer: only the warmup
+        # thread assigns (one atomic tuple rebind), and readers are
+        # ordered behind the _warmed Event set after this returns
         self.compiled_shapes = tuple(
             (B, L) for B in self._b_ladder for L in self._l_rungs)
         self._reg.set("serve/compiled_shapes",
@@ -629,6 +696,39 @@ class ScorerServer:
             list(self._b_ladder), list(self._l_rungs),
             time.monotonic() - t0)
 
+    def _mark_warmed(self) -> None:
+        self._warmed.set()
+        self._reg.set("serve/ready", 1.0 if self.is_ready() else 0.0)
+
+    def _warmup_bg(self) -> None:
+        """Background-warmup thread body: compile the ladder, then
+        flip ready. A warmup failure leaves the server alive but
+        permanently not-ready (counted + logged) — the fleet routes
+        around it and the operator sees serve/warmup_errors, instead
+        of a constructor traceback racing the supervisor's spawn."""
+        try:
+            self._warmup()
+        except BaseException as e:  # noqa: BLE001 - surface as state
+            # fmlint: disable=R008 -- single writer: only the warmup
+            # thread assigns this once (atomic rebind); readers merely
+            # surface it in healthz after the fact
+            self._warmup_error = e
+            self._reg.count("serve/warmup_errors")
+            self._logger.exception(
+                "serve warmup failed; server stays not-ready")
+            return
+        self._mark_warmed()
+
+    def is_ready(self) -> bool:
+        """The proxy-facing readiness bit: warmed up, not mid-reload,
+        not shutting down, admission queue below the shed depth.
+        Distinct from alive (an answering process) by design — see the
+        class docstring."""
+        return (self._warmed.is_set()
+                and not self._reloading.is_set()
+                and not self._closed
+                and self._q.qsize() < self._shed_depth)
+
     def stats(self) -> dict:
         """The /healthz payload: live counters + latency quantiles
         (server-local registry — exists with metrics on or off)."""
@@ -638,6 +738,10 @@ class ScorerServer:
                                   bounds=LATENCY_BUCKETS_MS)
         return {
             "status": "ok",
+            "alive": True,
+            "ready": self.is_ready(),
+            "warmed": self._warmed.is_set(),
+            "reloading": self._reloading.is_set(),
             "served_step": self.served_step,
             "published_step": self._published_step,
             "queue_depth": self._q.qsize(),

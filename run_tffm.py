@@ -7,7 +7,7 @@
     python run_tffm.py train   <cfg> --join
     python run_tffm.py predict <cfg>
     python run_tffm.py predict <cfg> dist_train <job_name> <task_index>
-    python run_tffm.py serve   <cfg>
+    python run_tffm.py serve   <cfg> [--replicas N]
 
 ``dist_train`` roles map onto synchronous jax.distributed processes
 instead of TF1 ps/worker async-SGD (SURVEY §7): ``worker i`` becomes DP
@@ -21,7 +21,11 @@ cluster and merges ordered score files on the chief.
 scorer: it loads the ``published`` checkpoint step, micro-batches
 concurrent requests behind a stdlib HTTP front end (POST /score, GET
 /healthz on ``serve_port``), and hot-reloads when the pointer moves.
-SIGTERM/SIGINT drain and exit cleanly.
+SIGTERM/SIGINT drain and exit cleanly. ``--replicas N`` (or
+``serve_replicas``; README "Serving fleet") instead runs the replica
+supervisor: N scorer children on ``serve_port + i`` behind the
+failover proxy on ``serve_proxy_port``, with health-gated routing,
+capped-backoff restarts, staggered hot reloads, and canary scoring.
 
 ``train --join`` (an extension; README "Elastic multi-host") launches
 a REPLACEMENT worker for a running ``elastic = grow`` cluster: it
@@ -37,7 +41,7 @@ from __future__ import annotations
 import os
 import sys
 
-from fast_tffm_tpu.config import load_config
+from fast_tffm_tpu.config import apply_env_overrides, load_config
 
 
 def _enable_compilation_cache() -> None:
@@ -81,34 +85,39 @@ def main(argv=None) -> int:
     rest = argv[2:]
     _enable_compilation_cache()
     cfg = load_config(cfg_path)
-    # One-off telemetry without editing the config file: the same
-    # values the `metrics_file` knob takes ("auto" =
-    # <model_file>.metrics.jsonl). Summarize with
-    # `python -m tools.fmstat <file>`.
-    metrics_override = os.environ.get("FM_METRICS_FILE")
-    if metrics_override:
-        import dataclasses
-        cfg = dataclasses.replace(cfg, metrics_file=metrics_override)
-    # Same one-off convention for the timeline/health layer: turn on
-    # span tracing (FM_TRACE_SPANS=1) or the stall watchdog
-    # (FM_WATCHDOG_STALL_SECONDS=120) for a single run without editing
-    # the config. Both need a metrics stream to write into.
-    spans_override = os.environ.get("FM_TRACE_SPANS", "")
-    if spans_override.strip().lower() in ("1", "true", "yes", "on"):
-        import dataclasses
-        cfg = dataclasses.replace(cfg, trace_spans=True)
-    stall_override = os.environ.get("FM_WATCHDOG_STALL_SECONDS")
-    if stall_override:
-        import dataclasses
-        cfg = dataclasses.replace(
-            cfg, watchdog_stall_seconds=float(stall_override))
+    # One-off per-process overrides without editing the config file:
+    # FM_METRICS_FILE (the `metrics_file` knob's values; "auto" =
+    # <model_file>.metrics.jsonl — summarize with `python -m
+    # tools.fmstat <file>`), FM_TRACE_SPANS / FM_WATCHDOG_STALL_SECONDS
+    # for the timeline/health layer, and the serve-fleet knobs the
+    # supervisor hands each replica (config.apply_env_overrides).
+    cfg = apply_env_overrides(cfg)
 
     if mode == "serve":
+        replicas = None
+        if rest and rest[0] == "--replicas":
+            if len(rest) != 2:
+                return _usage()
+            try:
+                replicas = int(rest[1])
+            except ValueError:
+                print(f"--replicas wants an integer, got {rest[1]!r}",
+                      file=sys.stderr)
+                return _usage()
+            if replicas < 1:
+                print("--replicas must be >= 1", file=sys.stderr)
+                return _usage()
+            rest = []
         if rest:
             print("serve takes no dist_train role: the scorer is "
-                  "single-process (run one per host behind a load "
-                  "balancer)", file=sys.stderr)
+                  "single-process; a multi-replica fleet is "
+                  "`serve <cfg> --replicas N` (README 'Serving "
+                  "fleet')", file=sys.stderr)
             return _usage()
+        n = replicas if replicas is not None else cfg.serve_replicas
+        if n > 1:
+            from fast_tffm_tpu.serve.fleet import run_fleet
+            return run_fleet(cfg, cfg_path, replicas=n)
         from fast_tffm_tpu.serve.frontend import run_serve
         return run_serve(cfg)
 

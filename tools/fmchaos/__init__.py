@@ -41,6 +41,25 @@ asserts the documented recovery behavior:
                       the p50/p99 latency histograms with served ==
                       published at close, and no fm-serve thread
                       survives close().
+- ``kill-replica-midburst`` the serving FLEET under fire (README
+                      "Serving fleet"): 3 supervised replica processes
+                      behind the failover proxy take a 4-thread
+                      request burst while one replica is SIGKILLed
+                      mid-flush → ZERO client-visible failures (the
+                      proxy retries on a different ready replica),
+                      every response bit-identical to batch predict
+                      per its step tag, a mid-incident fmstat
+                      snapshot reads FLEET DEGRADED (2/3 ready), the
+                      supervisor respawns the victim under backoff
+                      back to OK, and client p99 holds the [SLO]
+                      bound.
+- ``staggered-reload`` a fleet-wide hot reload under load: `fmckpt
+                      publish` repoints the pointer while clients
+                      fire through the proxy → the supervisor
+                      staggers the reload so a high-rate sampler on
+                      the proxy's /healthz NEVER sees ready == 0,
+                      responses land on both steps, and none is torn
+                      (byte parity against batch predict per step).
 - ``preempt-resume``  SIGTERM mid-epoch → the run saves and exits
                       cleanly, ``fmstat`` reports PREEMPTED (not
                       CRASHED); a restart resumes the interrupted
@@ -764,6 +783,459 @@ def scenario_serve_soak(workdir: str, seed: int = 0) -> str:
             f"all bit-identical to batch predict; p50="
             f"{att['serve_latency_p50_ms']:.1f}ms p99="
             f"{att['serve_latency_p99_ms']:.1f}ms, no thread leaks")
+
+
+# --- serving-fleet scenarios ---------------------------------------------
+
+
+def _free_port_block(n: int) -> int:
+    """Base of n consecutive bindable loopback ports — the fleet
+    contract puts replica i on ``serve_port + i``, so the scenario
+    needs a whole block, not n scattered ports."""
+    import socket
+    for _ in range(64):
+        socks = []
+        try:
+            s0 = socket.socket()
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+            socks.append(s0)
+            if base + n >= 65535:
+                continue
+            ok = True
+            for i in range(1, n):
+                s = socket.socket()
+                socks.append(s)
+                try:
+                    s.bind(("127.0.0.1", base + i))
+                except OSError:
+                    ok = False
+                    break
+            if ok:
+                return base
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no block of consecutive free loopback ports")
+
+
+def _fleet_cfg_file(workdir: str, data: str, replicas: int,
+                    base_port: int, **serve) -> str:
+    """The ONE config file both the in-process FleetSupervisor and its
+    replica child processes load (children see per-replica FM_* env
+    deltas on top — port, metrics shard, external reload mode)."""
+    knobs = {
+        "serve_port": base_port,
+        "serve_replicas": replicas,
+        "serve_proxy_port": 0,
+        "serve_max_batch": 8,
+        "serve_max_wait_ms": 2.0,
+        "serve_poll_seconds": 0.05,
+        "serve_health_poll_seconds": 0.1,
+        "serve_restart_backoff_seconds": 0.2,
+        "serve_retry_budget": 2,
+    }
+    knobs.update(serve)
+    block = "\n".join(f"{k} = {v}" for k, v in knobs.items())
+    path = os.path.join(workdir, "fleet.cfg")
+    with open(path, "w") as fh:
+        fh.write(f"""
+[General]
+vocabulary_size = 200
+factor_num = 4
+model_file = {os.path.join(workdir, 'model', 'fm')}
+log_file = {os.path.join(workdir, 'fleet.log')}
+
+[Train]
+train_files = {data}
+batch_size = 32
+learning_rate = 0.1
+epoch_num = 2
+save_steps = 5
+shuffle = true
+seed = 0
+log_steps = 0
+bucket_ladder = 8
+max_features_per_example = 8
+metrics_file = {os.path.join(workdir, 'fleet_metrics.jsonl')}
+metrics_flush_steps = 5
+io_backoff_seconds = 0.01
+
+[SLO]
+slo_p99_ms = 10000
+
+[Serve]
+{block}
+""")
+    return path
+
+
+def _replica_log_tails(cfg, tail: int = 2000) -> str:
+    out = []
+    for i in range(cfg.serve_replicas):
+        p = f"{cfg.model_file}.replica{i}.log"
+        try:
+            with open(p) as fh:
+                out.append(f"--- replica {i} ---\n{fh.read()[-tail:]}")
+        except OSError:
+            out.append(f"--- replica {i}: no log at {p} ---")
+    return "\n".join(out)
+
+
+def _fire_proxy(port: int, req_lines, seed: int, stop_firing,
+                results, res_lock, failures):
+    """One proxy client: variable-size bursts of libsvm lines POSTed
+    through the fleet front door, collecting (lines, response text,
+    step, latency ms) — or the failure, which the scenarios assert
+    NEVER happens."""
+    import http.client as _http
+    import time as _time
+    rng = np.random.default_rng(seed)
+    while not stop_firing.is_set():
+        k = int(rng.integers(1, 6))
+        lo = int(rng.integers(0, len(req_lines) - k))
+        lines = req_lines[lo:lo + k]
+        body = ("\n".join(lines) + "\n").encode("utf-8")
+        t0 = _time.monotonic()
+        try:
+            conn = _http.HTTPConnection("127.0.0.1", port, timeout=60)
+            try:
+                conn.request("POST", "/score", body=body,
+                             headers={"Content-Type": "text/plain"})
+                resp = conn.getresponse()
+                out = resp.read().decode("utf-8")
+                status = resp.status
+                step = resp.getheader("X-FM-Step")
+            finally:
+                conn.close()
+        except Exception as e:  # noqa: BLE001 - asserted empty later
+            failures.append(repr(e))
+            continue
+        lat_ms = (_time.monotonic() - t0) * 1000.0
+        if status != 200 or step is None:
+            failures.append(f"HTTP {status}: {out[:200]}")
+            continue
+        with res_lock:
+            results.append((lines, out, int(step), lat_ms))
+
+
+def _assert_fleet_parity(cfg, workdir: str, results) -> dict:
+    """Per-step byte parity: every proxied response's text must equal
+    the ``%.6f`` rendering of batch predict over the same lines
+    against the step that scored it (the X-FM-Step tag). Torn or
+    truncated responses fail here by construction. Returns the
+    responses grouped by step."""
+    import dataclasses as dc
+    from fast_tffm_tpu.metrics import sigmoid
+    from fast_tffm_tpu.predict import load_table, predict_scores
+    pcfg = dc.replace(cfg, metrics_file="")
+    by_step = {}
+    for lines, text, step, _lat in results:
+        by_step.setdefault(step, []).append((lines, text))
+    for step, pairs in sorted(by_step.items()):
+        table = load_table(pcfg, step=step)
+        req_path = os.path.join(workdir, f"fleet_requests_{step}.txt")
+        flat = [ln for lines, _text in pairs for ln in lines]
+        with open(req_path, "w") as fh:
+            fh.write("\n".join(flat) + "\n")
+        want = sigmoid(predict_scores(pcfg, table, [req_path]))
+        pos = 0
+        for lines, text in pairs:
+            n = len(lines)
+            ref = "".join(f"{v:.6f}\n" for v in want[pos:pos + n])
+            pos += n
+            assert text == ref, (
+                f"step {step}: proxied response diverged from batch "
+                f"predict on the same checkpoint ({text[:40]!r} vs "
+                f"{ref[:40]!r})")
+    return by_step
+
+
+def scenario_kill_replica_midburst(workdir: str, seed: int = 0) -> str:
+    """ISSUE 19 acceptance (tentpole): a 3-replica serving fleet
+    behind the failover proxy survives SIGKILL of one replica in the
+    middle of a concurrent request burst. Zero client-visible
+    failures (the proxy fails refused/reset forwards over to a
+    different ready replica), every response byte-identical to batch
+    predict against the step that scored it, a MID-INCIDENT fmstat
+    snapshot reads FLEET DEGRADED (2/3 ready) (the supervisor's eager
+    flush on the ready edge), the dead replica auto-restarts under
+    backoff back to 3/3 with the post-drain verdict OK, and the
+    client-observed p99 honors the [SLO] bound."""
+    import signal as _signal
+    import threading
+    import time as _time
+    from fast_tffm_tpu.checkpoint import (CheckpointState,
+                                          list_step_dirs)
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.obs.attribution import (health_verdict, render,
+                                               summarize)
+    from fast_tffm_tpu.serve.fleet import FleetSupervisor
+    from fast_tffm_tpu.train import train
+    from tools.fmckpt import cmd_publish
+    import dataclasses as dc
+
+    workdir = os.path.abspath(workdir)
+    data = os.path.join(workdir, "train.txt")
+    _write_corpus(data, 400, seed)
+    cfg_path = _fleet_cfg_file(workdir, data, replicas=3,
+                               base_port=_free_port_block(3))
+    cfg = load_config(cfg_path)
+    train(dc.replace(cfg, metrics_file=""))
+    ckpt = CheckpointState(cfg.model_file)
+    steps = list_step_dirs(ckpt.directory)
+    ckpt.close()
+    s_pub = steps[-1]
+    assert cmd_publish(cfg.model_file + ".ckpt", s_pub) == 0
+
+    sup = FleetSupervisor(cfg, cfg_path).start()
+    req_lines = _corpus_lines(60, seed + 99)
+    results, res_lock, failures = [], threading.Lock(), []
+    stop_firing = threading.Event()
+    clients = []
+    try:
+        assert sup.wait_ready(3, timeout=300), (
+            "fleet never reached 3 ready replicas:\n"
+            + _replica_log_tails(cfg))
+        clients = [threading.Thread(
+            target=_fire_proxy,
+            args=(sup.proxy_port, req_lines, seed + i, stop_firing,
+                  results, res_lock, failures),
+            name=f"burst-client-{i}") for i in range(4)]
+        for t in clients:
+            t.start()
+        deadline = _time.monotonic() + 60
+        while len(results) < 10:
+            assert _time.monotonic() < deadline, (
+                f"burst never started (failures: {failures[:3]})")
+            _time.sleep(0.01)
+
+        # The incident: SIGKILL one replica mid-burst.
+        victim = sup.replicas[1]
+        old_pid = victim.pid()
+        os.kill(old_pid, _signal.SIGKILL)
+        # Mid-incident observability: the supervisor flushes eagerly
+        # on the ready-count edge, so fmstat over the live stream must
+        # show the degradation window NOW, not after the fact.
+        deadline = _time.monotonic() + 60
+        while True:
+            v = health_verdict(summarize([cfg.metrics_file]))["verdict"]
+            if v.startswith("FLEET DEGRADED"):
+                break
+            assert _time.monotonic() < deadline, (
+                f"no FLEET DEGRADED snapshot mid-incident (verdict "
+                f"stayed {v!r})")
+            _time.sleep(0.05)
+        mid_verdict = v
+        # Self-healing: the supervisor respawns the victim (capped
+        # backoff) and the fleet returns to full strength.
+        assert sup.wait_ready(3, timeout=300), (
+            "killed replica never came back ready:\n"
+            + _replica_log_tails(cfg))
+        assert victim.pid() != old_pid, "victim was never respawned"
+        # Keep the burst going on the healed fleet before stopping.
+        n_mark = len(results)
+        deadline = _time.monotonic() + 60
+        while len(results) < n_mark + 10:
+            assert _time.monotonic() < deadline, (
+                f"no responses after recovery (failures: "
+                f"{failures[:3]})")
+            _time.sleep(0.01)
+        stop_firing.set()
+        for t in clients:
+            t.join()
+    finally:
+        stop_firing.set()
+        for t in clients:
+            t.join(timeout=30)
+        sup.stop()
+
+    assert not failures, (
+        f"{len(failures)} client-visible failure(s) — the proxy must "
+        f"absorb the kill: {failures[:3]}")
+    by_step = _assert_fleet_parity(cfg, workdir, results)
+    assert set(by_step) == {s_pub}, (
+        f"responses span steps {sorted(by_step)}, wanted [{s_pub}]")
+    lat = sorted(r[3] for r in results)
+    p99 = float(np.percentile(lat, 99))
+    assert p99 <= cfg.slo_p99_ms, (
+        f"client p99 {p99:.1f}ms blew the [SLO] slo_p99_ms = "
+        f"{cfg.slo_p99_ms} bound")
+    summ = summarize([cfg.metrics_file])
+    c = summ.get("counters", {})
+    assert c.get("fleet/deaths", 0) >= 1, c
+    assert c.get("fleet/restarts", 0) >= 1, c
+    assert c.get("proxy/requests") == len(results), (
+        c.get("proxy/requests"), len(results))
+    v_end = health_verdict(summ)["verdict"]
+    assert v_end == "OK", v_end
+    text = render(summ)
+    assert "FLEET (serve --replicas)" in text and "r2:" in text, text
+    leaked = [t.name for t in threading.enumerate()
+              if t.is_alive() and (t.name.startswith("fm-fleet")
+                                   or t.name.startswith("fm-proxy"))]
+    assert not leaked, f"leaked fleet threads: {leaked}"
+    retries = int(c.get("proxy/retries", 0)
+                  + c.get("proxy/transport_errors", 0))
+    return (f"{len(results)} proxied requests, 0 failures across a "
+            f"SIGKILL of replica 1 (pid {old_pid}) mid-burst "
+            f"({retries} failover retries/transport errors absorbed); "
+            f"mid-incident fmstat read '{mid_verdict}', the replica "
+            f"respawned and the final verdict is OK; all responses "
+            f"bit-identical to batch predict on step {s_pub}; "
+            f"p99 {p99:.1f}ms within the {cfg.slo_p99_ms}ms SLO")
+
+
+def scenario_staggered_reload(workdir: str, seed: int = 0) -> str:
+    """ISSUE 19 acceptance: a fleet-wide hot reload under load never
+    has a zero-ready instant. `fmckpt publish` repoints the pointer
+    while clients fire through the proxy; the supervisor staggers the
+    reload (each replica waits for another ready replica before
+    taking the token); a high-rate sampler on the proxy's aggregated
+    /healthz must never observe ready == 0; responses land on BOTH
+    steps and every one is byte-identical to batch predict against
+    its step — none torn."""
+    import json as _json
+    import http.client as _http
+    import threading
+    import time as _time
+    from fast_tffm_tpu.checkpoint import (CheckpointState,
+                                          list_step_dirs)
+    from fast_tffm_tpu.config import load_config
+    from fast_tffm_tpu.obs.attribution import health_verdict, summarize
+    from fast_tffm_tpu.serve.fleet import FleetSupervisor
+    from fast_tffm_tpu.train import train
+    from tools.fmckpt import cmd_publish
+    import dataclasses as dc
+
+    workdir = os.path.abspath(workdir)
+    data = os.path.join(workdir, "train.txt")
+    _write_corpus(data, 400, seed)
+    cfg_path = _fleet_cfg_file(workdir, data, replicas=2,
+                               base_port=_free_port_block(2))
+    cfg = load_config(cfg_path)
+    train(dc.replace(cfg, metrics_file=""))
+    ckpt = CheckpointState(cfg.model_file)
+    steps = list_step_dirs(ckpt.directory)
+    ckpt.close()
+    assert len(steps) >= 2, f"need >= 2 retained steps, got {steps}"
+    s_old, s_new = steps[0], steps[-1]
+    assert cmd_publish(cfg.model_file + ".ckpt", s_old) == 0
+
+    sup = FleetSupervisor(cfg, cfg_path).start()
+    req_lines = _corpus_lines(60, seed + 99)
+    results, res_lock, failures = [], threading.Lock(), []
+    stop_firing = threading.Event()
+    stop_sampling = threading.Event()
+    ready_samples = []
+    clients = []
+    sampler = None
+
+    def sample_healthz():
+        while not stop_sampling.is_set():
+            try:
+                conn = _http.HTTPConnection("127.0.0.1",
+                                            sup.proxy_port, timeout=5)
+                try:
+                    conn.request("GET", "/healthz")
+                    resp = conn.getresponse()
+                    payload = _json.loads(resp.read())
+                    ready_samples.append(
+                        (int(payload["ready"]), resp.status))
+                finally:
+                    conn.close()
+            except OSError:
+                pass  # proxy briefly unreachable = not a zero-ready
+            _time.sleep(0.005)
+
+    try:
+        assert sup.wait_ready(2, timeout=300), (
+            "fleet never reached 2 ready replicas:\n"
+            + _replica_log_tails(cfg))
+        sampler = threading.Thread(target=sample_healthz,
+                                   name="stagger-healthz-sampler")
+        sampler.start()
+        clients = [threading.Thread(
+            target=_fire_proxy,
+            args=(sup.proxy_port, req_lines, seed + i, stop_firing,
+                  results, res_lock, failures),
+            name=f"stagger-client-{i}") for i in range(3)]
+        for t in clients:
+            t.start()
+        deadline = _time.monotonic() + 60
+        while len(results) < 5:
+            assert _time.monotonic() < deadline, (
+                f"no responses before the publish (failures: "
+                f"{failures[:3]})")
+            _time.sleep(0.01)
+
+        # The reload, through the operator path, under load.
+        assert cmd_publish(cfg.model_file + ".ckpt", s_new) == 0
+        deadline = _time.monotonic() + 180
+        while True:
+            rows = [r.probe() for r in sup.replicas]
+            if all(h and h.get("served_step") == s_new
+                   and h.get("ready") for h in rows):
+                break
+            assert _time.monotonic() < deadline, (
+                f"staggered reload to step {s_new} never completed "
+                f"(rows: {rows})\n" + _replica_log_tails(cfg))
+            _time.sleep(0.05)
+        # A few responses must land on the NEW step before we stop.
+        deadline = _time.monotonic() + 60
+        while not any(r[2] == s_new for r in list(results)):
+            assert _time.monotonic() < deadline, (
+                "no responses on the reloaded step")
+            _time.sleep(0.01)
+        stop_firing.set()
+        for t in clients:
+            t.join()
+        stop_sampling.set()
+        sampler.join()
+        # Let the supervisor's CACHED health view (the source of the
+        # fleet/ready gauge) observe full strength again before the
+        # drain, so the final flush carries the healed fleet, not the
+        # mid-reload edge.
+        assert sup.wait_ready(2, timeout=60), (
+            "fleet health view never recovered to 2 ready after the "
+            "reload:\n" + _replica_log_tails(cfg))
+    finally:
+        stop_firing.set()
+        stop_sampling.set()
+        for t in clients:
+            t.join(timeout=30)
+        if sampler is not None:
+            sampler.join(timeout=10)
+        sup.stop()
+
+    assert not failures, (
+        f"{len(failures)} client-visible failure(s) during the "
+        f"staggered reload: {failures[:3]}")
+    assert ready_samples, "healthz sampler never sampled"
+    min_ready = min(s[0] for s in ready_samples)
+    assert min_ready >= 1, (
+        f"zero-ready window observed during the staggered reload "
+        f"({len(ready_samples)} samples)")
+    assert all(s[1] == 200 for s in ready_samples), (
+        "proxy /healthz went 503 during the reload")
+    by_step = _assert_fleet_parity(cfg, workdir, results)
+    assert set(by_step) == {s_old, s_new}, (
+        f"responses span steps {sorted(by_step)}, wanted "
+        f"{[s_old, s_new]}")
+    summ = summarize([cfg.metrics_file])
+    c = summ.get("counters", {})
+    assert c.get("fleet/reloads", 0) >= 2, c
+    assert c.get("fleet/reload_failures", 0) == 0, c
+    v_end = health_verdict(summ)["verdict"]
+    assert v_end == "OK", v_end
+    n_old = len(by_step[s_old])
+    n_new = len(by_step[s_new])
+    return (f"staggered reload {s_old} -> {s_new} under load: "
+            f"{len(results)} responses ({n_old} on the old step, "
+            f"{n_new} on the new), 0 failures, min ready across "
+            f"{len(ready_samples)} healthz samples = {min_ready} "
+            f"(never zero), {int(c['fleet/reloads'])} replica "
+            f"reloads, all responses bit-identical to batch predict")
 
 
 # --- streaming run-mode scenarios ----------------------------------------
@@ -2261,6 +2733,8 @@ SCENARIOS: Dict[str, Callable[..., str]] = {
     "flaky-open-parallel": scenario_flaky_open_parallel,
     "predict-flaky": scenario_predict_flaky,
     "serve-soak": scenario_serve_soak,
+    "kill-replica-midburst": scenario_kill_replica_midburst,
+    "staggered-reload": scenario_staggered_reload,
     "preempt-resume": scenario_preempt_resume,
     "stream-soak": scenario_stream_soak,
     "slo-soak": scenario_slo_soak,

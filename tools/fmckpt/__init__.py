@@ -4,6 +4,7 @@ directory (README "Checkpoint integrity & fallback").
     python -m tools.fmckpt ls <model_file | dir.ckpt>
     python -m tools.fmckpt verify <path> [--mode size|full] [--step N]
     python -m tools.fmckpt publish <path> <step> [--mode size|full]
+                                   [--canary]
     python -m tools.fmckpt gc <path> [--dry-run]
 
 The offline view of the invariants ``fast_tffm_tpu/checkpoint.py``
@@ -29,7 +30,11 @@ enforces at run time:
               "Serving"). A step that is missing or fails verification
               leaves the pointer untouched (exit 1): the pointer must
               only ever name verified bytes. ``ls`` shows the result
-              as the PUBLISHED mark.
+              as the PUBLISHED mark. ``--canary`` repoints the
+              ``published-canary`` pointer instead — the step a
+              fleet's canary replica scores (README "Serving fleet");
+              promote it by re-running publish without the flag,
+              roll back by publishing the previous step.
 - ``gc``      reclaim space: delete quarantined ``corrupt-*`` dirs and
               orphaned ``manifest-*``/``epoch_override-*`` sidecars
               whose step no longer exists. This is the ONE sanctioned
@@ -45,9 +50,9 @@ import os
 from typing import Dict, List, Optional
 
 from fast_tffm_tpu.checkpoint import (QUARANTINE_PREFIX, list_step_dirs,
-                                      read_epoch_override, read_manifest,
-                                      read_published, sidecar_step,
-                                      verify_step_dir,
+                                      read_canary, read_epoch_override,
+                                      read_manifest, read_published,
+                                      sidecar_step, verify_step_dir,
                                       vocab_sidecar_path, watermark_path)
 
 
@@ -130,7 +135,12 @@ def scan(directory: str) -> Dict[str, object]:
             "quarantined": quarantined, "orphans": orphans,
             # Stream-mode publish pointer (README "Streaming / online
             # learning"): the step a scorer should be serving.
-            "published": read_published(directory)}
+            "published": read_published(directory),
+            # Canary pointer (README "Serving fleet"): the step the
+            # fleet's canary replica scores; None when no canary
+            # publish happened (the canary replica then follows
+            # ``published``, via checkpoint.read_pointer's fallback).
+            "canary": read_canary(directory)}
 
 
 def _fmt_bytes(n: int) -> str:
@@ -162,6 +172,8 @@ def cmd_ls(directory: str, as_json: bool = False, out=None) -> int:
             marks += " +VOCAB"
         if state.get("published") == s["step"]:
             marks += "  PUBLISHED"
+        if state.get("canary") == s["step"]:
+            marks += "  CANARY"
         out.write(f"  step {s['step']:<10} {s['files']:>4} files "
                   f"{_fmt_bytes(s['bytes']):>10}  epoch={epoch} "
                   f"vocab={vocab}  {man}{marks}\n")
@@ -171,6 +183,12 @@ def cmd_ls(directory: str, as_json: bool = False, out=None) -> int:
         out.write(f"  published -> step {state['published']} "
                   "(MISSING: the pointed-at step is gone — GC'd or "
                   "quarantined since the publish)\n")
+    if (state.get("canary") is not None
+            and state["canary"] not in {s["step"]
+                                        for s in state["steps"]}):
+        out.write(f"  published-canary -> step {state['canary']} "
+                  "(MISSING: the pointed-at step is gone — the canary "
+                  "replica falls back to the published step)\n")
     for q in state["quarantined"]:
         out.write(f"  {q['name']:<15} {q['files']:>4} files "
                   f"{_fmt_bytes(q['bytes']):>10}  QUARANTINED "
@@ -246,14 +264,16 @@ def cmd_verify(directory: str, mode: str = "full",
 
 
 def cmd_publish(directory: str, step: int, mode: str = "size",
-                out=None) -> int:
+                canary: bool = False, out=None) -> int:
     """Verify-then-repoint (the operator half of the publish
     contract): the pointer moves ONLY when the step exists and passes
     the manifest check at ``mode`` — the same gate
     ``CheckpointState.publish_step`` applies, via the same shared
-    ``write_published`` atomic-rename write, so a serving process's
-    concurrent reload poll can never read a torn or unverified
-    value."""
+    atomic-rename write, so a serving process's concurrent reload poll
+    can never read a torn or unverified value. ``canary=True`` moves
+    the ``published-canary`` pointer instead (the fleet's canary
+    replica; README "Serving fleet") — the verification gate is
+    identical, a canary must never score unverified bytes either."""
     import sys
     out = out or sys.stdout
     committed = list_step_dirs(directory)
@@ -273,11 +293,17 @@ def cmd_publish(directory: str, step: int, mode: str = "size",
     if reason is not None:
         out.write(f"step {step}: FAIL — {reason}; pointer untouched\n")
         return 1
-    prev = read_published(directory)
-    from fast_tffm_tpu.checkpoint import write_published
-    path = write_published(directory, step)
+    from fast_tffm_tpu.checkpoint import write_canary, write_published
+    if canary:
+        prev = read_canary(directory)
+        path = write_canary(directory, step)
+        label = "published-canary"
+    else:
+        prev = read_published(directory)
+        path = write_published(directory, step)
+        label = "published"
     frm = f"step {prev} -> " if prev is not None else ""
-    out.write(f"published {frm}step {step} ({mode}-verified) -> "
+    out.write(f"{label} {frm}step {step} ({mode}-verified) -> "
               f"{path}\n")
     return 0
 
@@ -338,6 +364,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_pub.add_argument("step", type=int)
     p_pub.add_argument("--mode", choices=("size", "full"),
                        default="size")
+    p_pub.add_argument("--canary", action="store_true",
+                       help="repoint the published-canary pointer (the "
+                            "fleet's canary replica) instead of "
+                            "published")
     p_gc = sub.add_parser("gc", help="delete quarantined dirs + orphans")
     p_gc.add_argument("path")
     p_gc.add_argument("--dry-run", action="store_true")
@@ -352,5 +382,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "verify":
         return cmd_verify(directory, mode=args.mode, step=args.step)
     if args.cmd == "publish":
-        return cmd_publish(directory, args.step, mode=args.mode)
+        return cmd_publish(directory, args.step, mode=args.mode,
+                           canary=args.canary)
     return cmd_gc(directory, dry_run=args.dry_run)

@@ -25,7 +25,13 @@ multi-host"). Streaming runs (``run_mode = stream``) get a STREAMING
 section — watermark lag, files discovered/sealed/truncated/deleted,
 publishes, last-publish age — and the health verdict reads
 ``STALE PUBLISH`` when the last publish age exceeds 3x the configured
-interval (the serving fleet is reloading stale state). ``--json``
+interval (the serving fleet is reloading stale state). A replica
+supervisor's stream (``serve --replicas N``; README "Serving fleet")
+grows a FLEET section — per-replica alive/ready/step/queue rows plus
+proxy traffic, retry, and shed counters — and the health verdict
+reads ``FLEET DEGRADED (k/N ready)`` while any replica is down or
+warming (ranked above the staleness verdicts: a capacity gap is more
+urgent than a stale pointer). ``--json``
 emits the merged summary + attribution as one JSON object for
 scripting. ``--tail`` follows a live file and pretty-prints events as
 they land. ``--follow`` re-renders the full summary + verdict on a
